@@ -1,0 +1,173 @@
+"""Declarative parameter registry decoupled from absl.
+
+TPU-native re-design of the reference's flag system (ref:
+scripts/tf_cnn_benchmarks/flags.py:36-89). The registry lets the harness
+work both as a CLI (absl flags materialized by ``define_flags``) and as a
+library (``params.make_params(**overrides)`` constructs a validated Params
+object with no absl involvement) -- the "library/CLI duality" of the
+reference (SURVEY 5.6).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, List, Optional, Sequence
+
+
+class ParamSpec:
+  """Specification of a single benchmark parameter.
+
+  Mirrors the reference ParamSpec namedtuple (ref: flags.py:36-41) with
+  flag_type/default_value/description/kwargs, where kwargs carries
+  enum_values / lower_bound / upper_bound constraints that
+  ``params.validate_params`` enforces (ref: benchmark_cnn.py:962-990).
+  """
+
+  __slots__ = ("name", "flag_type", "default_value", "description", "kwargs")
+
+  def __init__(self, name: str, flag_type: str, default_value: Any,
+               description: str, kwargs: Optional[dict] = None):
+    self.name = name
+    self.flag_type = flag_type
+    self.default_value = default_value
+    self.description = description
+    self.kwargs = dict(kwargs or {})
+
+  def __repr__(self):
+    return (f"ParamSpec({self.name!r}, {self.flag_type!r}, "
+            f"{self.default_value!r})")
+
+
+# Global registry: name -> ParamSpec, in definition order (ref: flags.py:42).
+param_specs: "OrderedDict[str, ParamSpec]" = OrderedDict()
+
+
+def _define(name: str, flag_type: str, default_value: Any, description: str,
+            **kwargs) -> None:
+  if name in param_specs:
+    raise ValueError(f"Duplicate param definition: {name}")
+  param_specs[name] = ParamSpec(name, flag_type, default_value, description,
+                                kwargs)
+
+
+def DEFINE_string(name, default, help):  # noqa: N802
+  _define(name, "string", default, help)
+
+
+def DEFINE_boolean(name, default, help):  # noqa: N802
+  _define(name, "boolean", default, help)
+
+
+def DEFINE_integer(name, default, help, lower_bound=None, upper_bound=None):  # noqa: N802
+  _define(name, "integer", default, help, lower_bound=lower_bound,
+          upper_bound=upper_bound)
+
+
+def DEFINE_float(name, default, help, lower_bound=None, upper_bound=None):  # noqa: N802
+  _define(name, "float", default, help, lower_bound=lower_bound,
+          upper_bound=upper_bound)
+
+
+def DEFINE_enum(name, default, enum_values, help):  # noqa: N802
+  _define(name, "enum", default, help, enum_values=list(enum_values))
+
+
+def DEFINE_list(name, default, help):  # noqa: N802
+  if isinstance(default, str):
+    default = [s for s in default.split(",") if s]
+  _define(name, "list", list(default or []), help)
+
+
+def canonicalize_value(spec: ParamSpec, value: Any) -> Any:
+  """Coerce a raw (possibly string) value to the spec's python type."""
+  if value is None:
+    return None
+  t = spec.flag_type
+  if t == "string" or t == "enum":
+    return str(value)
+  if t == "boolean":
+    if isinstance(value, bool):
+      return value
+    if isinstance(value, str):
+      low = value.lower()
+      if low in ("true", "1", "yes"):
+        return True
+      if low in ("false", "0", "no"):
+        return False
+      raise ValueError(f"--{spec.name}: invalid boolean {value!r}")
+    return bool(value)
+  if t == "integer":
+    return int(value)
+  if t == "float":
+    return float(value)
+  if t == "list":
+    if isinstance(value, str):
+      return [s for s in value.split(",") if s]
+    return list(value)
+  raise ValueError(f"Unknown flag type {t!r} for {spec.name}")
+
+
+def check_value(spec: ParamSpec, value: Any) -> None:
+  """Validate one value against its spec's constraints.
+
+  Bounds/enum validation semantics mirror the reference
+  (ref: benchmark_cnn.py:962-990).
+  """
+  if value is None:
+    return
+  if spec.flag_type == "enum":
+    enum_values = spec.kwargs["enum_values"]
+    if value not in enum_values:
+      raise ValueError(
+          f"The value {value!r} of parameter {spec.name} must be one of "
+          f"{enum_values}")
+  lo = spec.kwargs.get("lower_bound")
+  hi = spec.kwargs.get("upper_bound")
+  if lo is not None and value < lo:
+    raise ValueError(
+        f"Param {spec.name}={value} is below lower bound {lo}")
+  if hi is not None and value > hi:
+    raise ValueError(
+        f"Param {spec.name}={value} is above upper bound {hi}")
+
+
+def define_flags(specs=None, aliases=None):
+  """Materialize every ParamSpec as an absl flag (ref: flags.py:72-89).
+
+  ``aliases`` maps alternate CLI names to registered params (e.g. the
+  reference's ``--num_gpus`` -> ``--num_devices``) via absl DEFINE_alias,
+  so reference command lines keep working.
+  """
+  from absl import flags as absl_flags  # local import: library use needs no absl
+  specs = specs if specs is not None else param_specs
+  definers = {
+      "string": absl_flags.DEFINE_string,
+      "boolean": absl_flags.DEFINE_boolean,
+      "integer": absl_flags.DEFINE_integer,
+      "float": absl_flags.DEFINE_float,
+      "list": absl_flags.DEFINE_list,
+  }
+  for name, spec in specs.items():
+    if name in absl_flags.FLAGS:
+      continue
+    if spec.flag_type == "enum":
+      absl_flags.DEFINE_enum(name, spec.default_value,
+                             spec.kwargs["enum_values"], spec.description)
+    else:
+      kwargs = {}
+      if spec.flag_type in ("integer", "float"):
+        kwargs = {k: v for k, v in spec.kwargs.items()
+                  if k in ("lower_bound", "upper_bound") and v is not None}
+      definers[spec.flag_type](name, spec.default_value, spec.description,
+                               **kwargs)
+  for alias, target in (aliases or {}).items():
+    if alias not in absl_flags.FLAGS and target in absl_flags.FLAGS:
+      absl_flags.DEFINE_alias(alias, target)
+
+
+def flag_values_as_dict(flag_values=None) -> dict:
+  """Extract registry-known values from parsed absl FLAGS."""
+  if flag_values is None:
+    from absl import flags as absl_flags
+    flag_values = absl_flags.FLAGS
+  return {name: getattr(flag_values, name) for name in param_specs}

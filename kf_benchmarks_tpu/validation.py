@@ -1,0 +1,92 @@
+"""Cross-flag validation rules.
+
+The reference scatters ~35 cross-flag checks through BenchmarkCNN.__init__
+(ref: benchmark_cnn.py:1268-1352); here they are standalone, unit-testable
+validators run before the runtime is constructed (SURVEY 7.1).
+"""
+
+from __future__ import annotations
+
+
+class ParamError(ValueError):
+  pass
+
+
+def validate_cross_flags(params) -> None:
+  """Raise ParamError on inconsistent flag combinations."""
+  p = params
+  if p.eval:
+    if p.forward_only:
+      raise ParamError("--eval is incompatible with --forward_only "
+                       "(ref :1269-1270)")
+    if p.job_name:
+      raise ParamError("--job_name is unsupported with --eval (ref :1273)")
+  if p.num_batches is not None and p.num_epochs is not None:
+    raise ParamError("At most one of --num_batches and --num_epochs may be "
+                     "set (ref :1300-1303)")
+  if p.num_batches is not None and p.num_batches <= 0:
+    raise ParamError("--num_batches must be positive")
+  if p.num_epochs is not None and p.num_epochs <= 0:
+    raise ParamError("--num_epochs must be positive")
+  if p.forward_only and p.variable_update in ("distributed_replicated",
+                                              "distributed_all_reduce",
+                                              "collective_all_reduce"):
+    raise ParamError(f"--forward_only cannot be used with "
+                     f"--variable_update={p.variable_update} (ref :1306-1310)")
+  if p.variable_update in ("horovod", "kungfu"):
+    # The reference requires one GPU per process for external DP runtimes
+    # (ref :1287-1297). On TPU the SPMD program owns every local chip, so we
+    # relax the device-count rule but keep the job_name exclusion.
+    if p.job_name:
+      raise ParamError(f"--job_name is incompatible with "
+                       f"--variable_update={p.variable_update} "
+                       f"(ref :1293-1297)")
+  if p.variable_update == "distributed_replicated":
+    if not p.job_name:
+      raise ParamError("distributed_replicated requires --job_name "
+                       "(ref :1311-1314)")
+    if not p.cross_replica_sync:
+      raise ParamError("distributed_replicated requires "
+                       "--cross_replica_sync=true (ref :1315-1318)")
+  if p.variable_update == "distributed_all_reduce" and not p.all_reduce_spec:
+    raise ParamError("distributed_all_reduce requires --all_reduce_spec "
+                     "(ref :1319-1321)")
+  if p.fp16_vars and not p.use_fp16:
+    raise ParamError("--fp16_vars requires --use_fp16 (ref :1330-1331)")
+  if p.fp16_enable_auto_loss_scale and not p.use_fp16:
+    raise ParamError("--fp16_enable_auto_loss_scale requires --use_fp16 "
+                     "(ref :1334-1336)")
+  if bool(p.learning_rate_decay_factor) != bool(p.num_epochs_per_decay):
+    raise ParamError("--learning_rate_decay_factor and "
+                     "--num_epochs_per_decay must be set together "
+                     "(ref :1271-1277)")
+  if p.learning_rate_decay_factor and p.init_learning_rate is None:
+    raise ParamError("LR decay flags require --init_learning_rate "
+                     "(ref :1271-1277)")
+  if p.minimum_learning_rate and not (p.learning_rate_decay_factor and
+                                      p.num_epochs_per_decay and
+                                      p.init_learning_rate is not None):
+    raise ParamError("--minimum_learning_rate requires "
+                     "--init_learning_rate, --learning_rate_decay_factor "
+                     "and --num_epochs_per_decay (ref :445-449, :1143-1146)")
+  if p.piecewise_learning_rate_schedule and p.init_learning_rate is not None:
+    raise ParamError("--piecewise_learning_rate_schedule cannot be combined "
+                     "with --init_learning_rate (ref :1104-1120)")
+  if (p.piecewise_learning_rate_schedule and
+      (p.learning_rate_decay_factor or p.num_learning_rate_warmup_epochs)):
+    raise ParamError("--piecewise_learning_rate_schedule cannot be combined "
+                     "with decay/warmup flags (ref :1116-1120)")
+  if p.eval_during_training_every_n_steps and p.eval:
+    raise ParamError("eval-during-training flags are incompatible with "
+                     "--eval (ref :1276-1280)")
+  if p.stop_at_top_1_accuracy and not p.eval_during_training_every_n_steps:
+    # The reference allows it only with eval-during-training (ref :1281-1286).
+    raise ParamError("--stop_at_top_1_accuracy requires eval-during-training "
+                     "(ref :1281-1286)")
+  if p.save_model_secs and p.save_model_steps:
+    raise ParamError("At most one of --save_model_secs and "
+                     "--save_model_steps may be set (ref :1341-1344)")
+  if p.forward_only and p.job_name == "controller":
+    raise ParamError("--forward_only is incompatible with controller jobs")
+  if p.device == "cpu" and p.data_format == "NCHW":
+    raise ParamError("NCHW is not supported on cpu device (ref :1323-1326)")

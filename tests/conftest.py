@@ -1,0 +1,16 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip hardware is unavailable in CI; sharding/collective tests run on
+XLA's host platform with 8 virtual devices, mirroring how the reference
+tests distributed modes without a real cluster (ref:
+benchmark_cnn_distributed_test.py spawns localhost processes; we use
+virtual devices instead -- SURVEY 7.1 test plan).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+  os.environ["XLA_FLAGS"] = (
+      xla_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,100 @@
+"""Tests for the config layer: ParamSpec registry, Params, validation.
+
+Mirrors the reference's flag/param tests (validation behavior at
+benchmark_cnn.py:962-990, cross-flag rules at :1268-1352).
+"""
+
+import pytest
+
+from kf_benchmarks_tpu import flags, params
+from kf_benchmarks_tpu.validation import ParamError, validate_cross_flags
+
+
+def test_defaults_construct():
+  p = params.make_params()
+  assert p.model == "trivial"
+  assert p.variable_update == "replicated"
+  assert p.device == "tpu"
+
+
+def test_override_and_alias():
+  p = params.make_params(model="resnet50", num_gpus=4, batch_size=64)
+  assert p.model == "resnet50"
+  assert p.num_devices == 4
+  assert p.batch_size == 64
+
+
+def test_unknown_param_rejected():
+  with pytest.raises(ValueError, match="Unknown param"):
+    params.make_params(not_a_param=1)
+
+
+def test_enum_validated():
+  with pytest.raises(ValueError, match="must be one of"):
+    params.make_params(variable_update="magic")
+
+
+def test_bounds_validated():
+  with pytest.raises(ValueError, match="lower bound"):
+    params.make_params(num_devices=0)
+  with pytest.raises(ValueError, match="upper bound"):
+    params.make_params(summary_verbosity=7)
+
+
+def test_string_coercion():
+  p = params.make_params(batch_size="32", use_fp16="true", momentum="0.8")
+  assert p.batch_size == 32 and p.use_fp16 is True and p.momentum == 0.8
+
+
+def test_remove_param_fields():
+  p = params.make_params(num_batches=10)
+  p2 = params.remove_param_fields(p, ["num_batches"])
+  assert p2.num_batches is None
+
+
+def test_registry_has_core_corpus():
+  # Spot-check that the reference's central flags exist (ref :114-636).
+  for name in ("model", "batch_size", "num_batches", "num_epochs",
+               "variable_update", "kungfu_option", "all_reduce_spec",
+               "optimizer", "use_fp16", "fp16_loss_scale", "train_dir",
+               "display_every", "forward_only", "eval", "data_dir",
+               "piecewise_learning_rate_schedule", "weight_decay",
+               "job_name", "task_index", "sync_on_finish"):
+    assert name in flags.param_specs, name
+
+
+class TestCrossFlagValidation:
+
+  def test_num_batches_and_epochs_exclusive(self):
+    p = params.make_params(num_batches=10)._replace(num_epochs=1.0)
+    with pytest.raises(ParamError):
+      validate_cross_flags(p)
+
+  def test_eval_forward_only_exclusive(self):
+    p = params.make_params(eval=True, forward_only=True)
+    with pytest.raises(ParamError):
+      validate_cross_flags(p)
+
+  def test_kungfu_job_name_rejected(self):
+    p = params.make_params(variable_update="kungfu")._replace(job_name="worker")
+    with pytest.raises(ParamError):
+      validate_cross_flags(p)
+
+  def test_fp16_vars_requires_fp16(self):
+    p = params.make_params(fp16_vars=True)
+    with pytest.raises(ParamError):
+      validate_cross_flags(p)
+
+  def test_distributed_replicated_needs_job(self):
+    p = params.make_params(variable_update="distributed_replicated")
+    with pytest.raises(ParamError):
+      validate_cross_flags(p)
+
+  def test_piecewise_and_init_lr_exclusive(self):
+    p = params.make_params(piecewise_learning_rate_schedule="0.1;10;0.01",
+                           init_learning_rate=0.1)
+    with pytest.raises(ParamError):
+      validate_cross_flags(p)
+
+  def test_clean_params_pass(self):
+    validate_cross_flags(params.make_params(model="resnet50", num_batches=10))
