@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 synthetic ImageNet images/sec on one chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline anchor: the reference's best committed single-GPU number --
+ResNet-50, synthetic ImageNet, batch 200, RTX 3090, 416.43 images/sec
+(BASELINE.md, slurm-2810608-200.out). vs_baseline = ours / 416.43.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_IMAGES_PER_SEC = 416.43
+
+
+def main():
+  from kf_benchmarks_tpu import params as params_lib
+  from kf_benchmarks_tpu import benchmark
+  from kf_benchmarks_tpu.utils import log as log_util
+
+  # Keep the bench quiet: route step logs to stderr so stdout carries
+  # only the JSON line.
+  log_util.log_fn = lambda s: print(s, file=sys.stderr, flush=True)
+  benchmark.log_fn = log_util.log_fn
+
+  # Probe TPU availability in a subprocess with a timeout: a wedged TPU
+  # tunnel makes jax.devices() block forever in-process, which must not
+  # hang the bench (it falls back to CPU instead).
+  import subprocess
+  try:
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; print(jax.devices()[0].platform)"],
+        capture_output=True, text=True, timeout=120)
+    on_tpu = probe.returncode == 0 and "cpu" not in probe.stdout
+  except subprocess.TimeoutExpired:
+    on_tpu = False
+  import jax
+  if not on_tpu:
+    jax.config.update("jax_platforms", "cpu")
+  params = params_lib.make_params(
+      model="resnet50",
+      batch_size=256 if on_tpu else 8,
+      num_batches=50 if on_tpu else 5,
+      num_warmup_batches=5 if on_tpu else 1,
+      device="tpu" if on_tpu else "cpu",
+      num_devices=1,
+      variable_update="replicated",
+      use_fp16=on_tpu,  # bfloat16 compute on TPU
+      optimizer="momentum",
+      display_every=10,
+  )
+  params = benchmark.setup(params)
+  bench = benchmark.BenchmarkCNN(params)
+  stats = bench.run()
+  value = stats["images_per_sec"]
+  print(json.dumps({
+      "metric": "resnet50_synthetic_images_per_sec",
+      "value": round(value, 2),
+      "unit": "images/sec",
+      "vs_baseline": round(value / BASELINE_IMAGES_PER_SEC, 3),
+  }), flush=True)
+
+
+if __name__ == "__main__":
+  main()

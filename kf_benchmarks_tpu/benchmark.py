@@ -1,0 +1,290 @@
+"""Core benchmark runtime: build -> compile -> warmup -> timed loop -> report.
+
+Re-design of the reference's BenchmarkCNN (ref: benchmark_cnn.py:1230-2391).
+The TF "graph + sess.run" pair becomes "jitted step fn + host loop"; the
+fetches dict becomes the step-output metrics pytree; warmup = compile + N
+discarded steps; the images/sec + uncertainty + jitter math and the
+per-step line format are kept exactly (SURVEY 7.1).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kf_benchmarks_tpu import learning_rate
+from kf_benchmarks_tpu import optimizers
+from kf_benchmarks_tpu import train_step as train_step_lib
+from kf_benchmarks_tpu import validation
+from kf_benchmarks_tpu.data import datasets
+from kf_benchmarks_tpu.models import model_config
+from kf_benchmarks_tpu.parallel import mesh as mesh_lib
+from kf_benchmarks_tpu.parallel import strategies
+from kf_benchmarks_tpu.parallel import kungfu
+from kf_benchmarks_tpu.utils import log as log_util
+
+def log_fn(msg):
+  """Late-bound so tests/bench can monkey-patch log_util.log_fn."""
+  log_util.log_fn(msg)
+
+
+def setup(params):
+  """Process-level setup (ref: benchmark_cnn.py:3356-3395).
+
+  The reference sets cuDNN/MKL env vars and runs a dummy session; the TPU
+  analogs are XLA flag plumbing and an eager device touch to trigger
+  runtime init ahead of the timed region.
+  """
+  if params.device == "cpu":
+    # Explicit CPU request. Note: must go through jax.config AFTER import,
+    # not the JAX_PLATFORMS env var -- this environment pins the env var
+    # to the axon TPU plugin at interpreter start.
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if (params.num_devices > 1 and
+        "xla_force_host_platform_device_count" not in xla_flags):
+      # Provision virtual host devices for multi-replica CPU runs. Only
+      # effective if the CPU backend has not been initialized yet.
+      os.environ["XLA_FLAGS"] = (
+          xla_flags + " --xla_force_host_platform_device_count="
+          f"{params.num_devices}").strip()
+    jax.config.update("jax_platforms", "cpu")
+  jax.devices()  # force backend init (ref dummy session :3383-3393)
+  return params
+
+
+class BenchmarkCNN:
+  """Benchmark driver (ref: benchmark_cnn.py:1230).
+
+  Args mirror the reference: Params plus optional dataset/model injection
+  (tests inject fake datasets/models the same way,
+  ref: benchmark_cnn.py:1230-1233).
+  """
+
+  def __init__(self, params, dataset=None, model=None):
+    from kf_benchmarks_tpu import params as params_lib
+    params_lib.validate_params(params)
+    validation.validate_cross_flags(params)
+    self.params = params
+    self.dataset = dataset or datasets.create_dataset(
+        params.data_dir, params.data_name)
+    self.model = model or model_config.get_model_config(
+        params.model, self.dataset.name, params)
+    if params.batch_size:
+      self.model.set_batch_size(params.batch_size)
+    self.batch_size_per_device = self.model.get_batch_size()
+    self.num_devices = params.num_devices
+    self.batch_size = self.batch_size_per_device * self.num_devices
+    # Multi-process (multi-host) runs multiply further (ref num_workers).
+    self.num_workers = jax.process_count()
+    self.mesh = mesh_lib.build_mesh(self.num_devices, params.device)
+    self.strategy = strategies.get_strategy(params)
+    self.num_batches = self._get_num_batches()
+    self.num_warmup_batches = (
+        params.num_warmup_batches if params.num_warmup_batches is not None
+        else 5)
+    self.display_every = params.display_every
+    dtype = jnp.float32
+    if params.use_fp16:
+      # bfloat16 on TPU; float16 kept for parity when explicitly requested
+      # through fp16_vars on non-TPU backends.
+      dtype = jnp.bfloat16 if params.device == "tpu" else jnp.float16
+    self.compute_dtype = dtype
+    self.param_dtype = dtype if params.fp16_vars else jnp.float32
+
+  def _get_num_batches(self) -> int:
+    p = self.params
+    if p.num_batches is not None:
+      return p.num_batches
+    if p.num_epochs is not None:
+      per_epoch = self.dataset.num_examples_per_epoch("train")
+      global_batch = self.batch_size * max(self.num_workers, 1)
+      return int(np.ceil(p.num_epochs * per_epoch / global_batch))
+    return 100  # reference default (ref: benchmark_cnn.py:137-139)
+
+  # -- info ----------------------------------------------------------------
+
+  def print_info(self):
+    """Run-config banner (ref: benchmark_cnn.py:1633-1692)."""
+    p = self.params
+    mode = "forward-only" if p.forward_only else (
+        "evaluation" if p.eval else "training")
+    log_fn("TensorFlow:   n/a (kf_benchmarks_tpu, JAX %s)" % jax.__version__)
+    log_fn("Model:       %s" % self.model.get_name())
+    log_fn("Dataset:     %s (%s)" % (
+        self.dataset.name,
+        "synthetic" if self.dataset.use_synthetic_gpu_inputs() else
+        self.dataset.data_dir))
+    log_fn("Mode:        %s" % mode)
+    log_fn("Batch size:  %d global" % (
+        self.batch_size * max(self.num_workers, 1)))
+    log_fn("             %d per device" % self.batch_size_per_device)
+    log_fn("Num batches: %d" % self.num_batches)
+    log_fn("Num devices: %d (%s)" % (self.num_devices, p.device))
+    log_fn("Data format: %s" % p.data_format)
+    log_fn("Precision:   %s (params: %s)" % (
+        jnp.dtype(self.compute_dtype).name,
+        jnp.dtype(self.param_dtype).name))
+    log_fn("Optimizer:   %s" % p.optimizer)
+    log_fn("Variables:   %s%s" % (
+        p.variable_update,
+        f" ({p.kungfu_option})" if p.variable_update == "kungfu" else ""))
+    log_fn("==========")
+
+  # -- build ---------------------------------------------------------------
+
+  def _build(self):
+    p = self.params
+    nclass = self.dataset.num_classes
+    module = self.model.make_module(
+        nclass=nclass, phase_train=not (p.eval or p.forward_only),
+        data_format=p.data_format, dtype=self.compute_dtype,
+        param_dtype=self.param_dtype)
+    eval_module = self.model.make_module(
+        nclass=nclass, phase_train=False, data_format=p.data_format,
+        dtype=self.compute_dtype, param_dtype=self.param_dtype)
+    lr_fn = learning_rate.make_learning_rate_fn(
+        p, self.model,
+        self.batch_size_per_device * (
+            self.num_devices if self.strategy.cross_replica else 1),
+        self.dataset.num_examples_per_epoch("train"), self.num_workers)
+    tx = optimizers.get_optimizer(p, lr_fn)
+    self._lr_fn = lr_fn
+    return train_step_lib.make_step_fns(
+        self.model, module, eval_module, self.strategy, tx, lr_fn, p,
+        self.mesh, compute_dtype=self.compute_dtype)
+
+  def _synthetic_global_batch(self, rng):
+    """Device-resident synthetic inputs, sharded over replicas
+    (ref: "minor hack to avoid H2D copy", benchmark_cnn.py:3008-3011)."""
+    nclass = self.dataset.num_classes
+    # Build the global batch with the model's per-device shape scaled up.
+    self.model.set_batch_size(self.batch_size_per_device)
+    images, labels = self.model.get_synthetic_inputs(rng, nclass)
+    global_images = jnp.tile(images, (self.num_devices,) + (1,) *
+                             (images.ndim - 1))
+    global_labels = jnp.tile(labels, (self.num_devices,))
+    batch_sharding = mesh_lib.batch_sharding(self.mesh)
+    return (jax.device_put(global_images, batch_sharding),
+            jax.device_put(global_labels, batch_sharding))
+
+  # -- run -----------------------------------------------------------------
+
+  def run(self) -> Dict[str, Any]:
+    """(ref: benchmark_cnn.py:1726-1755)"""
+    self.print_info()
+    if self.params.eval:
+      return self._run_eval()
+    return self._benchmark_train()
+
+  def _benchmark_train(self) -> Dict[str, Any]:
+    p = self.params
+    init_state, train_step, eval_step, broadcast_init = self._build()
+    rng = jax.random.PRNGKey(p.tf_random_seed or 0)
+    data_rng, init_rng = jax.random.split(rng)
+    images, labels = self._synthetic_global_batch(data_rng)
+
+    sample = jax.ShapeDtypeStruct(
+        (self.batch_size_per_device,) + tuple(images.shape[1:]),
+        images.dtype)
+    replicated = mesh_lib.replicated_sharding(self.mesh)
+    log_fn("Generating training model")
+    t0 = time.time()
+    state = jax.jit(
+        init_state,
+        static_argnums=(),
+        out_shardings=None)(init_rng, jnp.zeros(sample.shape, sample.dtype))
+    # Replica-0 broadcast at start (ref: benchmark_cnn.py:2094-2100).
+    state = state.replace(params=broadcast_init(state.params))
+    jax.block_until_ready(state.params)
+    log_fn("Initialization: %.1f s" % (time.time() - t0))
+
+    if p.forward_only:
+      # Forward-only benchmarks inference speed: no gradients, no
+      # optimizer, eval-phase module (ref: benchmark_cnn.py:124-126).
+      def run_step(state, images, labels):
+        return state, eval_step(state, images, labels)
+    else:
+      run_step = train_step
+
+    log_fn("Running warm up")
+    t0 = time.time()
+    for _ in range(self.num_warmup_batches):
+      state, metrics = run_step(state, images, labels)
+      jax.block_until_ready(metrics["total_loss"])
+    log_fn("Warmup (compile + %d steps): %.1f s" %
+           (self.num_warmup_batches, time.time() - t0))
+
+    header = "Step\tImg/sec\t" + p.loss_type_to_report
+    if p.print_training_accuracy:
+      header += "\ttop_1_accuracy\ttop_5_accuracy"
+    log_fn(header)
+
+    step_train_times = []
+    loss = float("nan")
+    loop_start = time.time()
+    for i in range(self.num_batches):
+      t0 = time.time()
+      state, metrics = run_step(state, images, labels)
+      loss = float(metrics[p.loss_type_to_report])  # sync point, as sess.run
+      step_train_times.append(time.time() - t0)
+      if (i + 1) % self.display_every == 0 or (i + 1) == self.num_batches:
+        top1 = (float(metrics["top_1_accuracy"])
+                if "top_1_accuracy" in metrics else None)
+        top5 = (float(metrics["top_5_accuracy"])
+                if "top_5_accuracy" in metrics else None)
+        log_fn(log_util.format_step_line(
+            i + 1, self.batch_size * max(self.num_workers, 1),
+            step_train_times[-self.display_every:], loss, top1, top5))
+    total_time = time.time() - loop_start
+
+    num_steps = len(step_train_times)
+    average_wall_time = total_time / num_steps if num_steps else 0
+    images_per_sec = (num_steps * self.batch_size *
+                      max(self.num_workers, 1) / total_time)
+    log_fn("-" * 64)
+    log_fn("total images/sec: %.2f" % images_per_sec)
+    log_fn("-" * 64)
+    if p.sync_on_finish:
+      kungfu.run_barrier()
+    # (ref stats dict: benchmark_cnn.py:2383-2391)
+    return {
+        "num_workers": max(self.num_workers, 1),
+        "num_steps": num_steps,
+        "average_wall_time": average_wall_time,
+        "images_per_sec": images_per_sec,
+        "last_average_loss": loss,
+        "state": state,
+    }
+
+  def _run_eval(self) -> Dict[str, Any]:
+    """Single-shot eval on synthetic/injected data
+    (ref: benchmark_cnn.py:1757-1794; checkpoint-poll loop arrives with
+    the checkpoint subsystem)."""
+    p = self.params
+    init_state, train_step, eval_step, broadcast_init = self._build()
+    rng = jax.random.PRNGKey(p.tf_random_seed or 0)
+    data_rng, init_rng = jax.random.split(rng)
+    images, labels = self._synthetic_global_batch(data_rng)
+    state = jax.jit(init_state)(
+        init_rng, jnp.zeros((self.batch_size_per_device,) +
+                            tuple(images.shape[1:]), images.dtype))
+    num_eval = p.num_eval_batches or self.num_batches
+    top1_sum = top5_sum = 0.0
+    start = time.time()
+    for _ in range(num_eval):
+      acc = eval_step(state, images, labels)
+      top1_sum += float(acc["top_1_accuracy"])
+      top5_sum += float(acc["top_5_accuracy"])
+    elapsed = time.time() - start
+    top1, top5 = top1_sum / num_eval, top5_sum / num_eval
+    log_fn("Accuracy @ 1 = %.4f Accuracy @ 5 = %.4f [%d examples]" %
+           (top1, top5, num_eval * self.batch_size))
+    return {"top_1_accuracy": top1, "top_5_accuracy": top5,
+            "eval_images_per_sec":
+            num_eval * self.batch_size / max(elapsed, 1e-9)}

@@ -1,0 +1,43 @@
+"""CLI entry point (ref: scripts/tf_cnn_benchmarks/tf_cnn_benchmarks.py).
+
+Run with: python -m kf_benchmarks_tpu.cli --model=resnet50 --num_batches=100
+"""
+
+from __future__ import annotations
+
+import sys
+
+from absl import app
+
+from kf_benchmarks_tpu import flags, params as params_lib
+
+
+def main(positional_arguments):
+  # Command-line arguments like '--model resnet50' are equivalent to
+  # '--model=resnet50'; positional args are forbidden
+  # (ref: tf_cnn_benchmarks.py:41-46).
+  assert len(positional_arguments) >= 1
+  if len(positional_arguments) > 1:
+    raise app.UsageError(
+        "Received unknown positional arguments: %s" % positional_arguments[1:])
+
+  from kf_benchmarks_tpu import benchmark
+  from kf_benchmarks_tpu.parallel import kungfu
+
+  params = params_lib.make_params_from_flags()
+  params = benchmark.setup(params)
+  bench = benchmark.BenchmarkCNN(params)
+  bench.run()
+
+  # KungFu exit barrier (ref: tf_cnn_benchmarks.py:58-60).
+  if params.variable_update == "kungfu":
+    kungfu.run_barrier()
+
+
+def run_main():
+  flags.define_flags(aliases=params_lib.ALIASES)
+  app.run(main)
+
+
+if __name__ == "__main__":
+  run_main()

@@ -1,0 +1,1 @@
+"""Datasets + input pipeline (ref: datasets.py, preprocessing.py)."""

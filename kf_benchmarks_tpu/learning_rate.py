@@ -1,0 +1,108 @@
+"""Learning-rate schedules.
+
+Re-implements the reference's LR policy resolution (ref:
+benchmark_cnn.py:1067-1169): piecewise 'LR0;E1;LR1;...' schedules,
+exponential decay with a floor, linear warmup, and model-default
+fallback -- as pure jnp functions of the global step (XLA-friendly:
+jnp.where chains, no python control flow on traced values).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def parse_piecewise_schedule(schedule_str: str):
+  """Parse 'LR0;E1;LR1;...;En;LRn' (ref: benchmark_cnn.py:1067-1101).
+
+  Returns (values, epoch_boundaries). Alternates LR and epoch tokens; epochs
+  must be strictly increasing positive ints.
+  """
+  pieces = schedule_str.split(";")
+  if len(pieces) % 2 == 0:
+    raise ValueError("--piecewise_learning_rate_schedule must have an odd "
+                     "number of components")
+  values = []
+  boundaries = []
+  for i, piece in enumerate(pieces):
+    if i % 2 == 0:
+      try:
+        values.append(float(piece))
+      except ValueError:
+        raise ValueError(f"Invalid learning rate: {piece!r}")
+    else:
+      try:
+        boundaries.append(int(piece))
+      except ValueError:
+        raise ValueError(f"Invalid epoch: {piece!r}")
+  if any(b <= a for a, b in zip(boundaries, boundaries[1:])) or (
+      boundaries and boundaries[0] <= 0):
+    raise ValueError("Epochs must be positive and increasing")
+  return np.array(values), np.array(boundaries)
+
+
+def piecewise_learning_rate(step, values, epoch_boundaries,
+                            num_batches_per_epoch: float):
+  step = jnp.asarray(step, jnp.float32)
+  lr = jnp.asarray(values[0], jnp.float32)
+  for epoch, v in zip(epoch_boundaries, values[1:]):
+    lr = jnp.where(step >= epoch * num_batches_per_epoch,
+                   jnp.asarray(v, jnp.float32), lr)
+  return lr
+
+
+def make_learning_rate_fn(params, model, batch_size: int,
+                          num_examples_per_epoch: int,
+                          num_workers: int = 1) -> Callable:
+  """Resolve the LR policy (ref: benchmark_cnn.py:1104-1169).
+
+  Priority: piecewise schedule > init_learning_rate (+decay/floor) >
+  model default. Warmup applies linearly over
+  num_learning_rate_warmup_epochs (ref :1147-1157).
+  """
+  num_batches_per_epoch = num_examples_per_epoch / float(
+      batch_size * max(num_workers, 1))
+
+  if params.piecewise_learning_rate_schedule:
+    values, boundaries = parse_piecewise_schedule(
+        params.piecewise_learning_rate_schedule)
+
+    def lr_fn(step):
+      return piecewise_learning_rate(step, values, boundaries,
+                                     num_batches_per_epoch)
+  elif params.init_learning_rate is not None:
+    init_lr = params.init_learning_rate
+
+    def lr_fn(step):
+      step = jnp.asarray(step, jnp.float32)
+      lr = jnp.asarray(init_lr, jnp.float32)
+      if params.num_epochs_per_decay and params.learning_rate_decay_factor:
+        decay_steps = params.num_epochs_per_decay * num_batches_per_epoch
+        num_decays = jnp.floor(step / decay_steps)
+        lr = init_lr * jnp.power(params.learning_rate_decay_factor,
+                                 num_decays)
+        if params.minimum_learning_rate:
+          lr = jnp.maximum(lr, params.minimum_learning_rate)
+      return lr
+  else:
+
+    def lr_fn(step):
+      return jnp.asarray(
+          model.get_learning_rate(step, batch_size * max(num_workers, 1)),
+          jnp.float32)
+
+  if params.num_learning_rate_warmup_epochs:
+    warmup_steps = params.num_learning_rate_warmup_epochs * \
+        num_batches_per_epoch
+    base_fn = lr_fn
+
+    def lr_fn(step):  # noqa: F811
+      step = jnp.asarray(step, jnp.float32)
+      lr = base_fn(step)
+      warmup_lr = lr * step / max(warmup_steps, 1.0)
+      return jnp.where(step < warmup_steps, warmup_lr, lr)
+
+  return lr_fn

@@ -1,0 +1,1 @@
+"""Model zoo + layer builder (ref: scripts/tf_cnn_benchmarks/models/)."""

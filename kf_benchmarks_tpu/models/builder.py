@@ -1,0 +1,313 @@
+"""ConvNetBuilder: imperative layer builder over flax.linen.
+
+TPU-native re-design of the reference's ConvNetBuilder (ref:
+scripts/tf_cnn_benchmarks/convnet_builder.py:29-468). Keeps the stateful
+``top_layer``/``top_size`` + auto-naming imperative style that makes the
+reference model zoo cheap to express, but each op instantiates flax
+submodules inside the enclosing module's compact scope, so the whole
+network is one traced function XLA can fuse and tile onto the MXU.
+
+Layout: NHWC is the default (TPU-native); NCHW accepted for parity.
+Reduced precision: activations/compute in ``dtype`` (bfloat16 on TPU when
+--use_fp16), parameters in ``param_dtype`` (fp32 master copies), which is
+the equivalent of the reference's fp16 custom-getter variable cast
+(ref: convnet_builder.py:56-86).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+def _activate(x, activation: Optional[str]):
+  if activation in (None, "linear"):
+    return x
+  if activation == "relu":
+    return nn.relu(x)
+  if activation == "relu6":
+    return nn.relu6(x)
+  if activation == "tanh":
+    return jnp.tanh(x)
+  if activation == "sigmoid":
+    return nn.sigmoid(x)
+  raise KeyError(f"Invalid activation type {activation!r}")
+
+
+class ConvNetBuilder:
+  """Builds a ConvNet anchored at ``self.top_layer`` (ref: convnet_builder.py:29)."""
+
+  def __init__(self, input_layer, phase_train: bool, data_format: str = "NHWC",
+               dtype=jnp.float32, param_dtype=jnp.float32,
+               use_batch_norm: bool = False,
+               batch_norm_config: Optional[dict] = None):
+    if data_format not in ("NHWC", "NCHW"):
+      raise ValueError(f"Invalid data_format {data_format!r}")
+    self.data_format = data_format
+    self.channel_axis = 3 if data_format == "NHWC" else 1
+    self.top_layer = jnp.asarray(input_layer, dtype)
+    self.top_size = int(input_layer.shape[self.channel_axis])
+    self.phase_train = phase_train
+    self.dtype = dtype
+    self.param_dtype = param_dtype
+    self.use_batch_norm = use_batch_norm
+    # Reference batch-norm defaults (ref: convnet_builder.py:408-420).
+    self.batch_norm_config = {"decay": 0.999, "epsilon": 0.001,
+                              "scale": False}
+    self.batch_norm_config.update(batch_norm_config or {})
+    self.counts = defaultdict(int)
+    self.aux_top_layer = None
+    self.aux_top_size = 0
+
+  # -- helpers -------------------------------------------------------------
+
+  def _name(self, kind: str) -> str:
+    n = self.counts[kind]
+    self.counts[kind] += 1
+    return f"{kind}{n}"
+
+  def _spatial(self, x):
+    if self.data_format == "NHWC":
+      return x
+    return jnp.transpose(x, (0, 2, 3, 1))  # to NHWC for the op
+
+  def _unspatial(self, x):
+    if self.data_format == "NHWC":
+      return x
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+  @contextlib.contextmanager
+  def switch_to_aux_top_layer(self):
+    """Context that redirects ops onto the auxiliary head
+    (ref: convnet_builder.py:88-101)."""
+    if self.aux_top_layer is None:
+      raise RuntimeError("aux_top_layer not set")
+    self.top_layer, self.aux_top_layer = self.aux_top_layer, self.top_layer
+    self.top_size, self.aux_top_size = self.aux_top_size, self.top_size
+    try:
+      yield
+    finally:
+      self.top_layer, self.aux_top_layer = self.aux_top_layer, self.top_layer
+      self.top_size, self.aux_top_size = self.aux_top_size, self.top_size
+
+  # -- layers --------------------------------------------------------------
+
+  def conv(self, num_out_channels: int, k_height: int, k_width: int,
+           d_height: int = 1, d_width: int = 1, mode: str = "SAME",
+           input_layer=None, num_channels_in: Optional[int] = None,
+           use_batch_norm: Optional[bool] = None, stddev: Optional[float] = None,
+           activation: Optional[str] = "relu", bias: Optional[float] = 0.0,
+           kernel_initializer=None, name: Optional[str] = None):
+    """2-D convolution (ref: convnet_builder.py:154-242).
+
+    ``SAME_RESNET`` mode reproduces the v1.5 stride-2 padding: explicit
+    (k-1) total padding before a VALID conv (ref: convnet_builder.py:205-223).
+    """
+    if input_layer is None:
+      input_layer = self.top_layer
+    name = name or self._name("conv")
+    use_bn = self.use_batch_norm if use_batch_norm is None else use_batch_norm
+    if kernel_initializer is None:
+      if stddev is None:
+        kernel_initializer = nn.initializers.variance_scaling(
+            2.0, "fan_in", "truncated_normal")
+      else:
+        kernel_initializer = nn.initializers.truncated_normal(stddev=stddev)
+    x = self._spatial(jnp.asarray(input_layer, self.dtype))
+    if mode == "SAME_RESNET":
+      if d_height > 1 or d_width > 1:
+        pad_h, pad_w = k_height - 1, k_width - 1
+        padding = [(pad_h // 2, pad_h - pad_h // 2),
+                   (pad_w // 2, pad_w - pad_w // 2)]
+      else:
+        padding = "SAME"
+    else:
+      padding = mode
+    x = nn.Conv(
+        features=num_out_channels,
+        kernel_size=(k_height, k_width),
+        strides=(d_height, d_width),
+        padding=padding,
+        use_bias=(not use_bn and bias is not None),
+        bias_init=nn.initializers.constant(bias or 0.0),
+        kernel_init=kernel_initializer,
+        dtype=self.dtype,
+        param_dtype=self.param_dtype,
+        name=name)(x)
+    x = self._unspatial(x)
+    if use_bn:
+      x = self._batch_norm_impl(x, name=name + "_bn")
+    x = _activate(x, activation)
+    self.top_layer = x
+    self.top_size = num_out_channels
+    return x
+
+  def _pool(self, pool: str, k_height: int, k_width: int, d_height: int,
+            d_width: int, mode: str, input_layer, name: Optional[str]):
+    if input_layer is None:
+      input_layer = self.top_layer
+    name = name or self._name(pool)
+    x = self._spatial(input_layer)
+    window = (1, k_height, k_width, 1)
+    strides = (1, d_height, d_width, 1)
+    if pool == "mpool":
+      init, op = -jnp.inf, jax.lax.max
+      x = jax.lax.reduce_window(x, init, op, window, strides, mode)
+    else:
+      summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides,
+                                     mode)
+      ones = jnp.ones(x.shape[1:3] + (1,), x.dtype)[None]
+      counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides,
+                                     mode)
+      x = summed / counts
+    x = self._unspatial(x)
+    self.top_layer = x
+    return x
+
+  def mpool(self, k_height, k_width, d_height=2, d_width=2, mode="VALID",
+            input_layer=None, name=None):
+    """Max pool (ref: convnet_builder.py:243-254)."""
+    return self._pool("mpool", k_height, k_width, d_height, d_width, mode,
+                      input_layer, name)
+
+  def apool(self, k_height, k_width, d_height=2, d_width=2, mode="VALID",
+            input_layer=None, name=None):
+    """Average pool (ref: convnet_builder.py:256-266)."""
+    return self._pool("apool", k_height, k_width, d_height, d_width, mode,
+                      input_layer, name)
+
+  def reshape(self, shape, input_layer=None):
+    """(ref: convnet_builder.py:268-273)"""
+    if input_layer is None:
+      input_layer = self.top_layer
+    x = jnp.reshape(input_layer, shape)
+    self.top_layer = x
+    self.top_size = int(x.shape[-1])
+    return x
+
+  def affine(self, num_out_channels: int, input_layer=None,
+             num_channels_in: Optional[int] = None, bias: float = 0.0,
+             stddev: Optional[float] = None, activation: Optional[str] = "relu",
+             name: Optional[str] = None):
+    """Fully connected layer (ref: convnet_builder.py:311-345)."""
+    if input_layer is None:
+      input_layer = self.top_layer
+    name = name or self._name("affine")
+    x = jnp.asarray(input_layer, self.dtype)
+    if x.ndim > 2:
+      x = jnp.reshape(x, (x.shape[0], -1))
+    if stddev is None:
+      kernel_init = nn.initializers.variance_scaling(
+          1.0, "fan_avg", "uniform")  # glorot, the TF dense default
+    else:
+      kernel_init = nn.initializers.truncated_normal(stddev=stddev)
+    x = nn.Dense(features=num_out_channels,
+                 kernel_init=kernel_init,
+                 bias_init=nn.initializers.constant(bias),
+                 dtype=self.dtype,
+                 param_dtype=self.param_dtype,
+                 name=name)(x)
+    x = _activate(x, activation)
+    self.top_layer = x
+    self.top_size = num_out_channels
+    return x
+
+  def inception_module(self, name: str, cols: Sequence[Sequence]):
+    """Column-parallel spec interpreter (ref: convnet_builder.py:347-384).
+
+    Each column is a list of (op_name, *args) tuples over ops of this
+    builder; column outputs are concatenated on the channel axis.
+    """
+    start_layer = self.top_layer
+    start_size = self.top_size
+    col_outputs = []
+    col_sizes = []
+    for c, column in enumerate(cols):
+      self.top_layer = start_layer
+      self.top_size = start_size
+      for op_spec in column:
+        op_name, args = op_spec[0], op_spec[1:]
+        if op_name == "share":
+          # Share the previous column's output so far (ref :366-370).
+          self.top_layer = col_outputs[-1]
+          self.top_size = col_sizes[-1]
+          continue
+        getattr(self, op_name)(*args)
+      col_outputs.append(self.top_layer)
+      col_sizes.append(self.top_size)
+    self.top_layer = jnp.concatenate(col_outputs, axis=self.channel_axis)
+    self.top_size = sum(col_sizes)
+    return self.top_layer
+
+  def spatial_mean(self, keep_dims: bool = False, input_layer=None):
+    """Global average pool over H,W (ref: convnet_builder.py:385-393)."""
+    if input_layer is None:
+      input_layer = self.top_layer
+    axes = (1, 2) if self.data_format == "NHWC" else (2, 3)
+    x = jnp.mean(input_layer, axis=axes, keepdims=keep_dims)
+    self.top_layer = x
+    return x
+
+  def dropout(self, keep_prob: float = 0.5, input_layer=None):
+    """(ref: convnet_builder.py:395-406). Note keep_prob, not rate."""
+    if input_layer is None:
+      input_layer = self.top_layer
+    name = self._name("dropout")
+    x = nn.Dropout(rate=1.0 - keep_prob, name=name)(
+        input_layer, deterministic=not self.phase_train)
+    self.top_layer = x
+    return x
+
+  def _batch_norm_impl(self, x, name, decay=None, scale=None, epsilon=None):
+    cfg = self.batch_norm_config
+    decay = cfg["decay"] if decay is None else decay
+    scale = cfg["scale"] if scale is None else scale
+    epsilon = cfg["epsilon"] if epsilon is None else epsilon
+    x = self._spatial(x)
+    x = nn.BatchNorm(
+        use_running_average=not self.phase_train,
+        momentum=decay,
+        epsilon=epsilon,
+        use_scale=scale,
+        use_bias=True,
+        dtype=self.dtype,
+        param_dtype=self.param_dtype,
+        name=name)(x)
+    return self._unspatial(x)
+
+  def batch_norm(self, input_layer=None, decay=None, scale=None,
+                 epsilon=None, name=None):
+    """Batch normalization (ref: convnet_builder.py:408-462)."""
+    if input_layer is None:
+      input_layer = self.top_layer
+    name = name or self._name("batchnorm")
+    x = self._batch_norm_impl(input_layer, name, decay=decay, scale=scale,
+                              epsilon=epsilon)
+    self.top_layer = x
+    return x
+
+  def lrn(self, depth_radius: int, bias: float, alpha: float, beta: float,
+          input_layer=None):
+    """Local response normalization (ref: convnet_builder.py:463-468).
+
+    Matches tf.nn.lrn semantics: sqr_sum[b,h,w,c] = sum over the
+    [c-r, c+r] channel window of squares; out = x / (bias + alpha*sqr_sum)^beta.
+    """
+    if input_layer is None:
+      input_layer = self.top_layer
+    x = self._spatial(input_layer)
+    squares = jnp.square(x)
+    window = 2 * depth_radius + 1
+    sqr_sum = jax.lax.reduce_window(
+        squares, 0.0, jax.lax.add,
+        (1, 1, 1, window), (1, 1, 1, 1),
+        [(0, 0), (0, 0), (0, 0), (depth_radius, depth_radius)])
+    x = x / jnp.power(bias + alpha * sqr_sum, beta)
+    x = self._unspatial(x)
+    self.top_layer = x
+    return x

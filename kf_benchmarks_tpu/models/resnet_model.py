@@ -1,0 +1,297 @@
+"""ResNet v1 / v1.5 / v2 for ImageNet and Cifar.
+
+TPU-native re-design of the reference ResNet (ref:
+scripts/tf_cnn_benchmarks/models/resnet_model.py:41-485): bottleneck /
+residual blocks expressed through the ConvNetBuilder, per-model default
+batch sizes, 0.1@bs256-scaled piecewise LR at epochs [30,60,80,90] with
+5-epoch linear warmup (ref :279-363), and cifar resnet20-110 variants
+(ref :392-485).
+
+Versions:
+  v1   -- stride-2 in the first 1x1 of the bottleneck (original paper).
+  v1.5 -- stride-2 moved to the 3x3 (the reference's default resnet50;
+          ref :97-116 "ResNet V1.5").
+  v2   -- preactivation (BN+ReLU before convs), identity shortcut add.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from kf_benchmarks_tpu.models import model
+
+IMAGENET_NUM_TRAIN_IMAGES = 1281167
+
+
+def bottleneck_block(cnn, depth: int, depth_bottleneck: int, stride: int,
+                     version: str):
+  """Bottleneck residual unit with 3 sub-layers (ref :41-170)."""
+  input_layer = cnn.top_layer
+  in_size = cnn.top_size
+  name_key = "resnet_v2" if version == "v2" else "resnet_v1"
+  name = f"{name_key}{cnn.counts[name_key]}"
+  cnn.counts[name_key] += 1
+
+  if version == "v2":
+    preact = cnn.batch_norm(name=name + "_preact_bn")
+    preact = _relu(cnn, preact)
+  else:
+    preact = input_layer
+
+  if in_size != depth or stride != 1:
+    # Projection shortcut (ref :58-76): 1x1 conv, no activation.
+    shortcut = cnn.conv(depth, 1, 1, stride, stride, mode="SAME_RESNET",
+                        input_layer=preact if version == "v2" else input_layer,
+                        num_channels_in=in_size, use_batch_norm=(version != "v2"),
+                        activation=None, bias=None, name=name + "_shortcut")
+  else:
+    shortcut = input_layer
+
+  body_in = preact if version == "v2" else input_layer
+  if version == "v1":
+    s1, s3 = stride, 1  # stride in the first 1x1 (ref :77-96)
+  else:
+    s1, s3 = 1, stride  # stride in the 3x3: v1.5 and v2 (ref :97-170)
+  use_bn = version != "v2"
+  x = cnn.conv(depth_bottleneck, 1, 1, s1, s1, input_layer=body_in,
+               num_channels_in=in_size, use_batch_norm=use_bn,
+               activation="relu" if use_bn else None, bias=None,
+               name=name + "_a")
+  if version == "v2":
+    x = cnn.batch_norm(name=name + "_a_bn")
+    x = _relu(cnn, x)
+  x = cnn.conv(depth_bottleneck, 3, 3, s3, s3, mode="SAME_RESNET",
+               use_batch_norm=use_bn,
+               activation="relu" if use_bn else None, bias=None,
+               name=name + "_b")
+  if version == "v2":
+    x = cnn.batch_norm(name=name + "_b_bn")
+    x = _relu(cnn, x)
+  x = cnn.conv(depth, 1, 1, 1, 1, use_batch_norm=use_bn, activation=None,
+               bias=None, name=name + "_c")
+  out = x + shortcut
+  if version != "v2":
+    out = _relu(cnn, out)
+  cnn.top_layer = out
+  cnn.top_size = depth
+  return out
+
+
+def residual_block(cnn, depth: int, stride: int, version: str):
+  """Two-3x3 residual unit for cifar resnets (ref :173-277)."""
+  input_layer = cnn.top_layer
+  in_size = cnn.top_size
+  name = f"resblk{cnn.counts['resblk']}"
+  cnn.counts["resblk"] += 1
+
+  if version == "v2":
+    preact = cnn.batch_norm(name=name + "_preact_bn")
+    preact = _relu(cnn, preact)
+    body_in = preact
+  else:
+    body_in = input_layer
+
+  if in_size != depth or stride != 1:
+    shortcut = cnn.conv(depth, 1, 1, stride, stride, mode="SAME_RESNET",
+                        input_layer=body_in, num_channels_in=in_size,
+                        use_batch_norm=(version != "v2"), activation=None,
+                        bias=None, name=name + "_shortcut")
+  else:
+    shortcut = input_layer
+
+  use_bn = version != "v2"
+  x = cnn.conv(depth, 3, 3, stride, stride, mode="SAME_RESNET",
+               input_layer=body_in, num_channels_in=in_size,
+               use_batch_norm=use_bn,
+               activation="relu" if use_bn else None, bias=None,
+               name=name + "_a")
+  if version == "v2":
+    x = cnn.batch_norm(name=name + "_a_bn")
+    x = _relu(cnn, x)
+  x = cnn.conv(depth, 3, 3, 1, 1, use_batch_norm=use_bn, activation=None,
+               bias=None, name=name + "_b")
+  out = x + shortcut
+  if version != "v2":
+    out = _relu(cnn, out)
+  cnn.top_layer = out
+  cnn.top_size = depth
+  return out
+
+
+def _relu(cnn, x):
+  import flax.linen as nn
+  out = nn.relu(x)
+  cnn.top_layer = out
+  return out
+
+
+class ResnetModel(model.CNNModel):
+  """ImageNet ResNet (ref :279-363)."""
+
+  def __init__(self, model_name: str, layer_counts, params=None):
+    # Per-model default batch sizes (ref :285-299).
+    default_batch_sizes = {
+        "resnet50": 64, "resnet101": 32, "resnet152": 32,
+        "resnet50_v1.5": 64, "resnet101_v1.5": 32,
+        "resnet50_v2": 64, "resnet101_v2": 32, "resnet152_v2": 32,
+    }
+    batch_size = default_batch_sizes.get(model_name, 32)
+    super().__init__(model_name, 224, batch_size, 0.1,
+                     layer_counts=layer_counts, params=params)
+    if "v2" in model_name:
+      self.version = "v2"
+    elif "v1.5" in model_name:
+      self.version = "v1.5"
+    else:
+      # The reference's plain 'resnet50' is v1.5 semantics (stride in the
+      # 3x3); true v1 is available as version override (ref :97-116).
+      self.version = "v1.5"
+
+  def add_inference(self, cnn):
+    if self.layer_counts is None:
+      raise ValueError(f"Layer counts not specified for {self.get_name()}")
+    cnn.use_batch_norm = self.version != "v2"
+    cnn.batch_norm_config = {"decay": 0.9, "epsilon": 1e-5, "scale": True}
+    cnn.conv(64, 7, 7, 2, 2, mode="SAME_RESNET",
+             use_batch_norm=(self.version != "v2"), activation="relu",
+             bias=None, name="conv_stem")
+    cnn.mpool(3, 3, 2, 2, mode="SAME")
+    for i, (count, depth_bottleneck, depth) in enumerate(
+        zip(self.layer_counts, (64, 128, 256, 512),
+            (256, 512, 1024, 2048))):
+      for j in range(count):
+        stride = 2 if (j == 0 and i > 0) else 1
+        bottleneck_block(cnn, depth, depth_bottleneck, stride, self.version)
+    if self.version == "v2":
+      cnn.batch_norm(name="final_bn")
+      _relu(cnn, cnn.top_layer)
+    cnn.spatial_mean()
+
+  def get_learning_rate(self, global_step, batch_size):
+    """0.1@bs256-scaled piecewise [30,60,80,90] + 5-epoch warmup
+    (ref :340-363)."""
+    num_batches_per_epoch = IMAGENET_NUM_TRAIN_IMAGES / float(batch_size)
+    rescaled_lr = 0.1 * batch_size / 256.0
+    boundaries = np.array([30, 60, 80, 90]) * num_batches_per_epoch
+    values = rescaled_lr * np.array([1.0, 0.1, 0.01, 0.001, 1e-4])
+    step = jnp.asarray(global_step, jnp.float32)
+    lr = jnp.asarray(values[0], jnp.float32)
+    for b, v in zip(boundaries, values[1:]):
+      lr = jnp.where(step >= b, jnp.asarray(v, jnp.float32), lr)
+    warmup_steps = int(5 * num_batches_per_epoch)
+    warmup_lr = rescaled_lr * step / max(warmup_steps, 1)
+    return jnp.where(step < warmup_steps, warmup_lr, lr)
+
+
+def create_resnet50_model(params=None):
+  return ResnetModel("resnet50", (3, 4, 6, 3), params=params)
+
+
+def create_resnet50_v15_model(params=None):
+  return ResnetModel("resnet50_v1.5", (3, 4, 6, 3), params=params)
+
+
+def create_resnet50_v2_model(params=None):
+  return ResnetModel("resnet50_v2", (3, 4, 6, 3), params=params)
+
+
+def create_resnet101_model(params=None):
+  return ResnetModel("resnet101", (3, 4, 23, 3), params=params)
+
+
+def create_resnet101_v2_model(params=None):
+  return ResnetModel("resnet101_v2", (3, 4, 23, 3), params=params)
+
+
+def create_resnet152_model(params=None):
+  return ResnetModel("resnet152", (3, 8, 36, 3), params=params)
+
+
+def create_resnet152_v2_model(params=None):
+  return ResnetModel("resnet152_v2", (3, 8, 36, 3), params=params)
+
+
+class ResnetCifar10Model(model.CNNModel):
+  """Cifar-10 ResNet-N, N in {20,32,44,56,110} (ref :392-485).
+
+  Uses 3 stages of (N-2)/6 residual blocks with widths 16/32/64 and the
+  reference's piecewise LR at epochs [82,123,300] (ref :462-485).
+  """
+
+  def __init__(self, model_name: str, layer_counts, params=None):
+    self.version = "v2" if "v2" in model_name else "v1"
+    super().__init__(model_name, 32, 128, 0.1, layer_counts=layer_counts,
+                     params=params)
+
+  def add_inference(self, cnn):
+    if self.layer_counts is None:
+      raise ValueError(f"Layer counts not specified for {self.get_name()}")
+    cnn.use_batch_norm = self.version != "v2"
+    cnn.batch_norm_config = {"decay": 0.9, "epsilon": 1e-5, "scale": True}
+    cnn.conv(16, 3, 3, 1, 1, use_batch_norm=(self.version != "v2"),
+             activation="relu" if self.version != "v2" else None,
+             bias=None, name="conv_stem")
+    for i, depth in enumerate((16, 32, 64)):
+      for j in range(self.layer_counts[i]):
+        stride = 2 if (j == 0 and i > 0) else 1
+        residual_block(cnn, depth, stride, self.version)
+    if self.version == "v2":
+      cnn.batch_norm(name="final_bn")
+      _relu(cnn, cnn.top_layer)
+    cnn.spatial_mean()
+
+  def get_learning_rate(self, global_step, batch_size):
+    num_batches_per_epoch = 50000 // batch_size
+    boundaries = num_batches_per_epoch * np.array([82, 123, 300])
+    values = np.array([0.1, 0.01, 0.001, 0.0002])
+    step = jnp.asarray(global_step, jnp.float32)
+    lr = jnp.asarray(values[0], jnp.float32)
+    for b, v in zip(boundaries, values[1:]):
+      lr = jnp.where(step >= b, jnp.asarray(v, jnp.float32), lr)
+    return lr
+
+
+def _cifar_layer_counts(depth: int):
+  n = (depth - 2) // 6
+  return (n, n, n)
+
+
+def create_resnet20_cifar_model(params=None):
+  return ResnetCifar10Model("resnet20", _cifar_layer_counts(20), params)
+
+
+def create_resnet20_v2_cifar_model(params=None):
+  return ResnetCifar10Model("resnet20_v2", _cifar_layer_counts(20), params)
+
+
+def create_resnet32_cifar_model(params=None):
+  return ResnetCifar10Model("resnet32", _cifar_layer_counts(32), params)
+
+
+def create_resnet32_v2_cifar_model(params=None):
+  return ResnetCifar10Model("resnet32_v2", _cifar_layer_counts(32), params)
+
+
+def create_resnet44_cifar_model(params=None):
+  return ResnetCifar10Model("resnet44", _cifar_layer_counts(44), params)
+
+
+def create_resnet44_v2_cifar_model(params=None):
+  return ResnetCifar10Model("resnet44_v2", _cifar_layer_counts(44), params)
+
+
+def create_resnet56_cifar_model(params=None):
+  return ResnetCifar10Model("resnet56", _cifar_layer_counts(56), params)
+
+
+def create_resnet56_v2_cifar_model(params=None):
+  return ResnetCifar10Model("resnet56_v2", _cifar_layer_counts(56), params)
+
+
+def create_resnet110_cifar_model(params=None):
+  return ResnetCifar10Model("resnet110", _cifar_layer_counts(110), params)
+
+
+def create_resnet110_v2_cifar_model(params=None):
+  return ResnetCifar10Model("resnet110_v2", _cifar_layer_counts(110), params)

@@ -1,0 +1,27 @@
+"""Trivial MLP smoke-test model (ref: models/trivial_model.py:20-43)."""
+
+from kf_benchmarks_tpu.models import model
+
+
+class TrivialModel(model.CNNModel):
+  """Flatten -> 1-unit bottleneck -> 4096 hidden, as in the reference."""
+
+  def __init__(self, params=None):
+    super().__init__("trivial", 224 + 3, 32, 0.005, params=params)
+
+  def add_inference(self, cnn):
+    cnn.reshape([-1, 227 * 227 * 3])
+    cnn.affine(1)
+    cnn.affine(4096)
+
+
+class TrivialCifar10Model(model.CNNModel):
+  """Cifar-sized trivial model (ref: models/trivial_model.py:33-43)."""
+
+  def __init__(self, params=None):
+    super().__init__("trivial", 32, 32, 0.005, params=params)
+
+  def add_inference(self, cnn):
+    cnn.reshape([-1, 32 * 32 * 3])
+    cnn.affine(1)
+    cnn.affine(4096)

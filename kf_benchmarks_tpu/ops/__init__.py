@@ -1,0 +1,2 @@
+"""Collective-communication ops: spec parsing, packing, reduction planning
+(ref: scripts/tf_cnn_benchmarks/allreduce.py, batch_allreduce.py)."""

@@ -1,0 +1,38 @@
+"""Optimizer construction.
+
+(ref: benchmark_cnn.py:1172-1205 get_optimizer). The KungFu wrapper
+injection of the reference happens in the parallel layer here
+(strategies.KungFuStrategy hooks), keeping optimizers pure optax
+transformations. LARS is added beyond the reference set -- it is the
+standard large-batch ResNet optimizer on TPU pods.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import optax
+
+
+def get_optimizer(params, learning_rate: Union[float, Callable]):
+  """Build the optax optimizer from params (ref: benchmark_cnn.py:1172-1205)."""
+  opt = params.optimizer
+  if opt == "sgd":
+    tx = optax.sgd(learning_rate)
+  elif opt == "momentum":
+    tx = optax.sgd(learning_rate, momentum=params.momentum, nesterov=True)
+  elif opt == "rmsprop":
+    tx = optax.rmsprop(learning_rate, decay=params.rmsprop_decay,
+                       momentum=params.rmsprop_momentum,
+                       eps=params.rmsprop_epsilon)
+  elif opt == "adam":
+    tx = optax.adam(learning_rate, b1=params.adam_beta1,
+                    b2=params.adam_beta2, eps=params.adam_epsilon)
+  elif opt == "lars":
+    tx = optax.lars(learning_rate, momentum=params.momentum)
+  else:
+    raise ValueError(f"Optimizer {opt!r} not supported")
+  if params.gradient_clip is not None:
+    tx = optax.chain(
+        optax.clip(params.gradient_clip), tx)
+  return tx

@@ -1,0 +1,6 @@
+"""Parallelism strategies + collective primitives.
+
+Replaces the reference's VariableMgr hierarchy (ref:
+scripts/tf_cnn_benchmarks/variable_mgr.py) and the KungFu distributed
+runtime surface (SURVEY 2.9) with SPMD designs over a jax.sharding.Mesh.
+"""

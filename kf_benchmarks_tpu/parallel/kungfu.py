@@ -1,0 +1,119 @@
+"""TPU-native KungFu API surface.
+
+Re-implements the KungFu capabilities the reference consumes (SURVEY 2.9;
+call sites: benchmark_cnn.py:1192-1204 optimizer wrap, :1408-1410 cluster
+size, :2044-2048/:2629-2631 rank, :2097-2100 broadcast-at-init,
+tf_cnn_benchmarks.py:58-60 exit barrier) on JAX collectives:
+
+  allreduce            -> lax.pmean over the 'replica' mesh axis (ICI)
+  pair-averaging gossip-> lax.ppermute of the weights (deterministic
+                          synchronous schedule; see PairAveraging below)
+  broadcast            -> replica-0 masked psum
+  barrier              -> multihost sync_global_devices (DCN) or no-op
+  cluster size / rank  -> mesh axis size / axis_index inside SPMD code,
+                          jax.process_count/index on the host side
+
+The KungFu runtime itself (Go peer mesh) is replaced by the XLA SPMD
+runtime plus the native coordination service in native/ (control plane).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kf_benchmarks_tpu.parallel.mesh import REPLICA_AXIS
+
+
+# -- host-side cluster introspection (ref: kungfu.python.*) -----------------
+
+def current_cluster_size() -> int:
+  """World size without global init (ref call: benchmark_cnn.py:1408-1410).
+
+  In the SPMD design a "worker" of the reference maps to a device, so the
+  cluster size is the global device count, not the process count.
+  """
+  return jax.device_count()
+
+
+def current_rank() -> int:
+  """Host-side rank (ref call: benchmark_cnn.py:2044-2048).
+
+  Rank of this process's first device; chief election
+  (``current_rank() == 0``) matches the reference's use.
+  """
+  return jax.process_index() * max(jax.local_device_count(), 1)
+
+
+def run_barrier() -> None:
+  """Global barrier before exit (ref: tf_cnn_benchmarks.py:58-60)."""
+  if jax.process_count() > 1:
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("kf_benchmarks_tpu_exit_barrier")
+
+
+# -- in-SPMD collective ops (used inside shard_map bodies) ------------------
+
+def allreduce_mean(tree, axis_name: str = REPLICA_AXIS):
+  """Gradient averaging: the S-SGD data plane (KungFu allreduce -> psum)."""
+  return jax.tree.map(lambda x: lax.pmean(x, axis_name), tree)
+
+
+def broadcast(tree, root: int = 0, axis_name: str = REPLICA_AXIS):
+  """Replica-``root`` broadcast of a pytree (ref: kungfu broadcast,
+  benchmark_cnn.py:2097-2100): mask non-root values, psum."""
+  idx = lax.axis_index(axis_name)
+  mask = (idx == root).astype(jnp.float32)
+
+  def bcast(x):
+    return lax.psum(x.astype(jnp.float32) * mask, axis_name).astype(x.dtype)
+
+  return jax.tree.map(bcast, tree)
+
+
+def gossip_shift(step, axis_size: int):
+  """Deterministic peer offset for pair-averaging at this step.
+
+  AD-PSGD's asynchronous random pairing has no SPMD analog; the
+  convergence-equivalent synchronous schedule rotates the partner offset
+  through 1..n-1 so every replica mixes with every other within n-1 steps
+  (SURVEY 7.4 "Pair-averaging gossip on TPU").
+  """
+  if axis_size <= 1:
+    return jnp.zeros_like(jnp.asarray(step))
+  return 1 + jnp.asarray(step) % (axis_size - 1)
+
+
+def pair_average(tree, step, axis_name: str = REPLICA_AXIS):
+  """One gossip round: average weights with the step's partner
+  (KungFu PairAveragingOptimizer data plane -> ppermute).
+
+  Each replica i receives from (i - shift) mod n and averages. This is the
+  row-stochastic gossip matrix W = (I + P)/2 with P a cyclic permutation:
+  doubly stochastic, so the network average is preserved exactly -- the
+  property AD-PSGD's analysis needs.
+  """
+  n = lax.axis_size(axis_name)
+  if n == 1:
+    return tree
+  # All possible cyclic-shift permutations are baked into a switch so the
+  # partner can vary per step without retracing (static perm lists).
+  def make_branch(shift):
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    def branch(t):
+      return jax.tree.map(
+          lambda x: 0.5 * (x + lax.ppermute(x, axis_name, perm)), t)
+    return branch
+
+  branches = [make_branch(s) for s in range(1, n)]
+  shift = gossip_shift(step, n)
+  return lax.switch(jnp.asarray(shift - 1, jnp.int32), branches, tree)
+
+
+def sync_average(tree, axis_name: str = REPLICA_AXIS):
+  """Synchronous model averaging (KungFu SynchronousAveragingOptimizer /
+  SMA, EA-SGD style): all-replica mean of the weights."""
+  return jax.tree.map(lambda x: lax.pmean(x, axis_name), tree)

@@ -1,0 +1,50 @@
+"""Device mesh construction.
+
+The reference enumerates raw device strings per tower
+(ref: benchmark_cnn.py:1419-1426); the TPU-native analog is a named
+jax.sharding.Mesh whose axes carry the parallelism semantics. Data
+parallelism (the only axis the reference has) is the 'replica' axis;
+model axes ('stage', 'tensor') are reserved for the pipeline/tensor
+extensions.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+REPLICA_AXIS = "replica"
+
+
+def get_devices(device_kind: str = "tpu", num_devices: Optional[int] = None):
+  """Resolve the local device list (ref: benchmark_cnn.py:1419-1426)."""
+  devices = jax.devices()
+  if device_kind == "cpu":
+    cpus = [d for d in devices if d.platform == "cpu"]
+    devices = cpus or devices
+  if num_devices is not None:
+    if num_devices > len(devices):
+      raise ValueError(
+          f"Requested {num_devices} devices but only {len(devices)} "
+          f"available ({[str(d) for d in devices]})")
+    devices = devices[:num_devices]
+  return devices
+
+
+def build_mesh(num_devices: Optional[int] = None, device_kind: str = "tpu",
+               devices: Optional[Sequence] = None) -> Mesh:
+  """1-D data-parallel mesh over the replica axis."""
+  if devices is None:
+    devices = get_devices(device_kind, num_devices)
+  return Mesh(np.asarray(devices), (REPLICA_AXIS,))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+  return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+  return NamedSharding(mesh, P(REPLICA_AXIS))

@@ -1,0 +1,180 @@
+"""Parallelism strategies: the VariableMgr hierarchy, re-designed SPMD.
+
+The reference's VariableMgr subclasses (ref: variable_mgr.py:28-831)
+answer: where do variables live, how are gradients aggregated, what syncs
+at init. Under SPMD all replicas run one program, so each strategy
+becomes a set of pure hooks called inside the shard_mapped train step:
+
+  reduce_gradients  -- gradient aggregation (psum / spec-driven / none)
+  pre_update        -- weight transform before the optimizer step (SMA)
+  post_update       -- weight transform after the step (pair-averaging)
+  sync_batch_stats  -- BN running-stat treatment across replicas
+  broadcast_init    -- replica-0 state broadcast at start
+
+Mapping from --variable_update (ref selection: benchmark_cnn.py:1481-1524):
+  independent            -> no reduction (ref: variable_mgr.py:164-198)
+  replicated             -> pmean grads (ref: variable_mgr.py:277-368)
+  parameter_server       -> pmean grads; sharded optimizer state is the
+                            TPU analog of central variable placement
+                            (ref: variable_mgr.py:201-243; SURVEY 5.8)
+  distributed_replicated -> pmean within + across processes (one SPMD
+                            program spans hosts; ref: variable_mgr.py:704-831)
+  distributed_all_reduce / collective_all_reduce
+                         -> spec-driven reduction (ref: variable_mgr.py:371-625)
+  horovod                -> per-gradient pmean (ref: benchmark_cnn.py:3122-3130)
+  kungfu                 -> optimizer-level hooks per --kungfu_option
+                            (ref: benchmark_cnn.py:1192-1204)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax import lax
+
+from kf_benchmarks_tpu.parallel import kungfu
+from kf_benchmarks_tpu.parallel.mesh import REPLICA_AXIS
+
+
+class Strategy:
+  """Base: single-replica semantics (no cross-replica traffic)."""
+
+  name = "independent"
+  # Whether gradients are averaged across replicas (determines whether the
+  # effective batch for LR scaling is the global batch).
+  cross_replica = False
+
+  def __init__(self, params=None):
+    self.params = params
+
+  def reduce_gradients(self, grads, axis_name=REPLICA_AXIS):
+    return grads
+
+  def pre_update(self, model_params, step, axis_name=REPLICA_AXIS):
+    return model_params
+
+  def post_update(self, model_params, step, axis_name=REPLICA_AXIS):
+    return model_params
+
+  def sync_batch_stats(self, batch_stats, axis_name=REPLICA_AXIS):
+    """Replicated modes keep BN stats identical (pmean); independent modes
+    keep tower-local stats like the reference's per-tower BN."""
+    return batch_stats
+
+  def broadcast_init(self, tree, axis_name=REPLICA_AXIS):
+    """Replica-0 broadcast at session start (ref: benchmark_cnn.py:2094-2100).
+    Under SPMD, identical init makes this a no-op for most strategies, but
+    independent/kungfu keep it for parity with explicitly diverged state."""
+    return tree
+
+
+class IndependentStrategy(Strategy):
+  """(ref: variable_mgr.py:164-198)"""
+  name = "independent"
+
+
+class ReplicatedStrategy(Strategy):
+  """All-reduce averaged gradients, replicated weights
+  (ref: variable_mgr.py:277-368)."""
+
+  name = "replicated"
+  cross_replica = True
+
+  def reduce_gradients(self, grads, axis_name=REPLICA_AXIS):
+    return kungfu.allreduce_mean(grads, axis_name)
+
+  def sync_batch_stats(self, batch_stats, axis_name=REPLICA_AXIS):
+    return jax.tree.map(lambda x: lax.pmean(x, axis_name), batch_stats)
+
+
+class ParameterServerStrategy(ReplicatedStrategy):
+  """PS analog: synchronous aggregation; on TPU the 'server' is the
+  sharded optimizer state, not a host process (SURVEY 5.8 gRPC-PS row)."""
+  name = "parameter_server"
+
+
+class CollectiveAllReduceStrategy(ReplicatedStrategy):
+  """Spec-driven reduction (ref: variable_mgr.py:486-625). The all-reduce
+  spec planner (ops/allreduce.py) may decompose pmean into
+  reduce-scatter + all-gather or hierarchical 2-level reductions."""
+  name = "collective_all_reduce"
+
+  def __init__(self, params=None, planner=None):
+    super().__init__(params)
+    self.planner = planner
+
+  def reduce_gradients(self, grads, axis_name=REPLICA_AXIS):
+    if self.planner is not None:
+      return self.planner.reduce(grads, axis_name)
+    return kungfu.allreduce_mean(grads, axis_name)
+
+
+class KungFuStrategy(Strategy):
+  """KungFu optimizer-wrapper semantics (ref: benchmark_cnn.py:1192-1204;
+  SURVEY 2.9), dispatched on --kungfu_option:
+
+    sync_sgd  -- SynchronousSGDOptimizer: pmean gradients before apply
+    async_sgd -- PairAveragingOptimizer: local grads + pairwise weight
+                 gossip (ppermute), reformulated synchronous (SURVEY 7.4)
+    sma       -- SynchronousAveragingOptimizer: average weights, then
+                 local gradient step
+  """
+
+  name = "kungfu"
+
+  def __init__(self, params=None, option: str = "sync_sgd"):
+    super().__init__(params)
+    if option not in ("sync_sgd", "async_sgd", "sma"):
+      raise ValueError(f"Invalid kungfu_option {option!r}")
+    self.option = option
+    self.cross_replica = option == "sync_sgd"
+
+  def reduce_gradients(self, grads, axis_name=REPLICA_AXIS):
+    if self.option == "sync_sgd":
+      return kungfu.allreduce_mean(grads, axis_name)
+    return grads
+
+  def pre_update(self, model_params, step, axis_name=REPLICA_AXIS):
+    if self.option == "sma":
+      return kungfu.sync_average(model_params, axis_name)
+    return model_params
+
+  def post_update(self, model_params, step, axis_name=REPLICA_AXIS):
+    if self.option == "async_sgd":
+      return kungfu.pair_average(model_params, step, axis_name)
+    return model_params
+
+  def sync_batch_stats(self, batch_stats, axis_name=REPLICA_AXIS):
+    if self.option == "sync_sgd":
+      return jax.tree.map(lambda x: lax.pmean(x, axis_name), batch_stats)
+    return batch_stats
+
+  def broadcast_init(self, tree, axis_name=REPLICA_AXIS):
+    return kungfu.broadcast(tree, root=0, axis_name=axis_name)
+
+
+def get_strategy(params) -> Strategy:
+  """Strategy selection (ref: benchmark_cnn.py:1481-1524)."""
+  vu = params.variable_update
+  if vu == "independent":
+    return IndependentStrategy(params)
+  if vu in ("replicated", "distributed_replicated"):
+    return ReplicatedStrategy(params)
+  if vu == "parameter_server":
+    return ParameterServerStrategy(params)
+  if vu in ("collective_all_reduce", "distributed_all_reduce"):
+    planner = None
+    if params.all_reduce_spec:
+      from kf_benchmarks_tpu.ops import allreduce
+      planner = allreduce.build_planner(params)
+    return CollectiveAllReduceStrategy(params, planner=planner)
+  if vu == "horovod":
+    # Horovod's per-gradient allreduce has the same SPMD data plane as
+    # replicated (ref: benchmark_cnn.py:3122-3130).
+    s = ReplicatedStrategy(params)
+    s.name = "horovod"
+    return s
+  if vu == "kungfu":
+    return KungFuStrategy(params, option=params.kungfu_option)
+  raise ValueError(f"Unknown variable_update {vu!r}")

@@ -62,7 +62,8 @@ flags.DEFINE_boolean("print_training_accuracy", False,
                      "Compute and print top-1/top-5 during training "
                      "(ref :127-129).")
 flags.DEFINE_integer("display_every", 10,
-                     "Print step stats every N steps (ref :173-175).")
+                     "Print step stats every N steps (ref :173-175).",
+                     lower_bound=1)
 flags.DEFINE_string("data_dir", None,
                     "Path to dataset; synthetic data if empty (ref :186-190).")
 flags.DEFINE_string("data_name", None,

@@ -1,0 +1,271 @@
+"""Jitted train/eval step construction.
+
+This is the TPU-native heart of the framework, replacing the reference's
+graph build + per-tower loop + sess.run (ref: benchmark_cnn.py:2619-2731
+_build_model, :2958-3209 add_forward_pass_and_gradients, :786-884
+benchmark_one_step). Design:
+
+* One SPMD program over a jax.sharding.Mesh with a 'replica' axis.
+* Per-replica state convention: every TrainState leaf carries a leading
+  replica dimension sharded P('replica') -- the exact analog of the
+  reference's per-GPU variable copies (v0..vN scopes,
+  variable_mgr.py:175-177, :277-368). Replicated strategies keep the
+  copies bit-identical via collectives; independent/gossip strategies let
+  them diverge, which pmap-style stacked state expresses naturally.
+* Strategy hooks (parallel/strategies.py) run inside the shard_mapped
+  body: gradient psum for replicated/sync-SGD, ppermute weight gossip for
+  pair-averaging, weight pmean for SMA.
+* Loss scaling: the reference's auto-loss-scale state machine
+  (variable_mgr_util.py:51-139) is carried in TrainState and stepped with
+  jnp.where -- halve-on-nonfinite + skip update, double every N clean
+  steps.
+* bf16: activations/compute in bfloat16 when --use_fp16 on TPU; params
+  stay fp32 master copies (the fp16 custom-getter analog).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+import flax
+import optax
+
+from kf_benchmarks_tpu.parallel.mesh import REPLICA_AXIS
+
+
+@flax.struct.dataclass
+class TrainState:
+  step: Any
+  params: Any
+  opt_state: Any
+  batch_stats: Any
+  loss_scale: Any
+  loss_scale_normal_steps: Any
+  rng: Any
+
+
+def _is_batch_norm_param(path) -> bool:
+  """L2 filtering: the reference excludes batch-norm variables from weight
+  decay (ref: models/model.py filter_l2_loss_vars; benchmark_cnn.py:3078-3099)."""
+  return any("bn" in str(k).lower() or "batchnorm" in str(k).lower()
+             for k in path)
+
+
+def l2_loss(params, single_op: bool = False):
+  """0.5 * sum of squares over non-BN params (tf.nn.l2_loss semantics,
+  ref: benchmark_cnn.py:3078-3099). ``single_op`` concatenates first
+  (ref --single_l2_loss_op); numerically identical, kept as a knob."""
+  leaves = []
+  flat = jax.tree_util.tree_flatten_with_path(params)[0]
+  for path, leaf in flat:
+    if not _is_batch_norm_param(path):
+      leaves.append(leaf)
+  if not leaves:
+    return jnp.float32(0.0)
+  if single_op:
+    flat_vec = jnp.concatenate([jnp.ravel(l).astype(jnp.float32)
+                                for l in leaves])
+    return 0.5 * jnp.sum(flat_vec * flat_vec)
+  return 0.5 * sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                   for l in leaves)
+
+
+def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
+                  mesh, compute_dtype=jnp.float32):
+  """Build (init_fn, train_step, eval_step) jitted over ``mesh``.
+
+  All three operate on per-replica stacked state (leading replica dim).
+  """
+  num_replicas = mesh.devices.size
+  weight_decay = params.weight_decay or 0.0
+  # Loss-scale resolution (ref: benchmark_cnn.py:471-480 "None = model
+  # default"): float16 compute defaults to the model's scale (128);
+  # bfloat16 needs none unless explicitly requested.
+  if params.use_fp16:
+    if params.fp16_loss_scale is not None:
+      init_loss_scale = float(params.fp16_loss_scale)
+    elif compute_dtype == jnp.float16:
+      init_loss_scale = float(model.get_fp16_loss_scale())
+    else:
+      init_loss_scale = 1.0
+  else:
+    init_loss_scale = 1.0
+  auto_loss_scale = bool(params.use_fp16 and
+                         params.fp16_enable_auto_loss_scale)
+  use_loss_scale = auto_loss_scale or init_loss_scale != 1.0
+  inc_every_n = params.fp16_inc_loss_scale_every_n
+
+  state_specs = TrainState(
+      step=P(), params=P(REPLICA_AXIS), opt_state=P(REPLICA_AXIS),
+      batch_stats=P(REPLICA_AXIS), loss_scale=P(),
+      loss_scale_normal_steps=P(), rng=P())
+
+  def _squeeze(tree):
+    return jax.tree.map(lambda x: jnp.squeeze(x, axis=0), tree)
+
+  def _expand(tree):
+    return jax.tree.map(lambda x: x[None], tree)
+
+  # -- init -----------------------------------------------------------------
+
+  def _init(rng, sample_images):
+    variables = module.init({"params": rng, "dropout": rng}, sample_images)
+    model_params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    opt_state = tx.init(model_params)
+    return model_params, opt_state, batch_stats
+
+  def init_state(rng, sample_images):
+    """Builds the stacked per-replica TrainState (identical init on every
+    replica == the reference's post-init broadcast, variable_mgr.py:342-356)."""
+    model_params, opt_state, batch_stats = _init(rng, sample_images)
+    stack = lambda t: jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (num_replicas,) + x.shape), t)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=stack(model_params),
+        opt_state=stack(opt_state),
+        batch_stats=stack(batch_stats),
+        loss_scale=jnp.asarray(init_loss_scale, jnp.float32),
+        loss_scale_normal_steps=jnp.zeros((), jnp.int32),
+        rng=rng)
+
+  # -- train step -----------------------------------------------------------
+
+  def per_replica_train(state, images, labels):
+    model_params = _squeeze(state.params)
+    opt_state = _squeeze(state.opt_state)
+    batch_stats = _squeeze(state.batch_stats)
+    replica_id = lax.axis_index(REPLICA_AXIS)
+    step_rng = jax.random.fold_in(
+        jax.random.fold_in(state.rng, state.step), replica_id)
+
+    def loss_fn(p):
+      variables = {"params": p}
+      if batch_stats:
+        variables["batch_stats"] = batch_stats
+      (logits, aux_logits), updates = module.apply(
+          variables, images, mutable=["batch_stats"],
+          rngs={"dropout": step_rng})
+      new_bs = updates.get("batch_stats", batch_stats)
+      from kf_benchmarks_tpu.models.model import BuildNetworkResult
+      result = BuildNetworkResult(logits=(logits, aux_logits))
+      base_loss = model.loss_function(result, labels)
+      total_loss = base_loss
+      if weight_decay:
+        total_loss = total_loss + weight_decay * l2_loss(
+            p, single_op=params.single_l2_loss_op)
+      scaled = total_loss * state.loss_scale
+      return scaled, (base_loss, total_loss, new_bs, result)
+
+    grads, (base_loss, total_loss, new_bs, net_result) = jax.grad(
+        loss_fn, has_aux=True)(model_params)
+    if use_loss_scale or auto_loss_scale:
+      grads = jax.tree.map(lambda g: g / state.loss_scale, grads)
+    grads = strategy.reduce_gradients(grads, REPLICA_AXIS)
+
+    model_params_pre = strategy.pre_update(model_params, state.step,
+                                           REPLICA_AXIS)
+    updates, new_opt_state = tx.update(grads, opt_state, model_params_pre)
+    new_params = optax.apply_updates(model_params_pre, updates)
+    new_params = strategy.post_update(new_params, state.step, REPLICA_AXIS)
+    new_bs = strategy.sync_batch_stats(new_bs, REPLICA_AXIS)
+
+    if auto_loss_scale:
+      # Auto loss-scale state machine (ref: variable_mgr_util.py:51-139):
+      # any non-finite grad -> skip update, halve scale; else count a
+      # normal step and double the scale every ``inc_every_n``. The
+      # finite-decision is made globally (pmin across replicas) so the
+      # loss scale stays replicated under every strategy -- the analog of
+      # the reference's chief-only NaN check + broadcast decision
+      # (variable_mgr.py:186-193).
+      finite = jnp.all(jnp.stack(
+          [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)]))
+      finite = lax.pmin(finite.astype(jnp.int32), REPLICA_AXIS).astype(bool)
+      keep = lambda new, old: jax.tree.map(
+          lambda a, b: jnp.where(finite, a, b), new, old)
+      new_params = keep(new_params, model_params)
+      new_opt_state = keep(new_opt_state, opt_state)
+      new_bs = keep(new_bs, batch_stats)
+      normal_steps = jnp.where(finite,
+                               state.loss_scale_normal_steps + 1,
+                               0)
+      do_double = jnp.logical_and(finite, normal_steps >= inc_every_n)
+      new_scale = jnp.where(
+          finite,
+          jnp.where(do_double, state.loss_scale * 2.0, state.loss_scale),
+          jnp.maximum(state.loss_scale / 2.0, 1.0))
+      normal_steps = jnp.where(do_double, 0, normal_steps)
+    else:
+      new_scale = state.loss_scale
+      normal_steps = state.loss_scale_normal_steps
+
+    lr = lr_fn(state.step)
+    metrics = {
+        "base_loss": lax.pmean(base_loss, REPLICA_AXIS),
+        "total_loss": lax.pmean(total_loss, REPLICA_AXIS),
+        "learning_rate": lr,
+    }
+    if params.print_training_accuracy:
+      acc = model.accuracy_function(net_result, labels)
+      metrics.update({k: lax.pmean(v, REPLICA_AXIS) for k, v in acc.items()})
+
+    new_state = TrainState(
+        step=state.step + 1,
+        params=_expand(new_params),
+        opt_state=_expand(new_opt_state),
+        batch_stats=_expand(new_bs),
+        loss_scale=new_scale,
+        loss_scale_normal_steps=normal_steps,
+        rng=state.rng)
+    return new_state, metrics
+
+  train_sharded = jax.shard_map(
+      per_replica_train, mesh=mesh,
+      in_specs=(state_specs, P(REPLICA_AXIS), P(REPLICA_AXIS)),
+      out_specs=(state_specs, P()))
+
+  train_step = jax.jit(train_sharded, donate_argnums=(0,))
+
+  # -- forward-only / eval step --------------------------------------------
+
+  def per_replica_eval(state, images, labels):
+    model_params = _squeeze(state.params)
+    batch_stats = _squeeze(state.batch_stats)
+    variables = {"params": model_params}
+    if batch_stats:
+      variables["batch_stats"] = batch_stats
+    logits, aux_logits = eval_module.apply(variables, images)
+    from kf_benchmarks_tpu.models.model import BuildNetworkResult
+    result = BuildNetworkResult(logits=(logits, aux_logits))
+    acc = model.accuracy_function(result, labels)
+    loss = model.loss_function(result, labels)
+    metrics = {k: lax.pmean(v, REPLICA_AXIS) for k, v in acc.items()}
+    # Loss included so the forward-only timed loop can print the standard
+    # step line (ref forward-only mode: benchmark_cnn.py:124-126).
+    metrics["base_loss"] = lax.pmean(loss, REPLICA_AXIS)
+    metrics["total_loss"] = metrics["base_loss"]
+    return metrics
+
+  eval_sharded = jax.shard_map(
+      per_replica_eval, mesh=mesh,
+      in_specs=(state_specs, P(REPLICA_AXIS), P(REPLICA_AXIS)),
+      out_specs=P())
+  eval_step = jax.jit(eval_sharded)
+
+  # -- broadcast-init (strategy-dependent; ref: benchmark_cnn.py:2094-2100) --
+
+  def per_replica_broadcast(tree):
+    return _expand(strategy.broadcast_init(_squeeze(tree), REPLICA_AXIS))
+
+  broadcast_sharded = jax.shard_map(
+      per_replica_broadcast, mesh=mesh,
+      in_specs=(P(REPLICA_AXIS),), out_specs=P(REPLICA_AXIS))
+  broadcast_init = jax.jit(broadcast_sharded)
+
+  return init_state, train_step, eval_step, broadcast_init

@@ -1,0 +1,1 @@
+"""Shared utilities (ref: scripts/tf_cnn_benchmarks/cnn_util.py)."""

@@ -1,0 +1,57 @@
+"""Logging + per-step perf stats.
+
+Keeps the reference's exact step-line format so its log-scraping tests
+port over (ref: cnn_util.py:37-38 log_fn; benchmark_cnn.py:838-846 step
+line; :887-902 get_perf_timing; :2351-2354 final banner).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Sequence
+
+
+def log_fn(string: str) -> None:
+  """(ref: cnn_util.py:37-38); monkey-patchable for log-scraping tests."""
+  print(string, flush=True)
+
+
+def get_perf_timing(batch_size: int, step_train_times: Sequence[float],
+                    ewma_alpha: float = None, scale: float = 1.0):
+  """images/sec mean, uncertainty, jitter (ref: benchmark_cnn.py:887-902).
+
+  uncertainty = std(speeds)/sqrt(n); jitter = median absolute deviation
+  of the per-step speeds.
+  """
+  times = list(step_train_times)
+  if not times:
+    return 0.0, 0.0, 0.0
+  speeds = [batch_size / t * scale for t in times]
+  n = len(speeds)
+  speed_mean = scale * batch_size / (sum(times) / n)
+  if n <= 1:
+    return speed_mean, 0.0, 0.0
+  mean_of_speeds = sum(speeds) / n
+  variance = sum((s - mean_of_speeds) ** 2 for s in speeds) / n
+  speed_uncertainty = math.sqrt(variance) / math.sqrt(n)
+  med = sorted(speeds)[n // 2]
+  speed_jitter = sorted(abs(s - med) for s in speeds)[n // 2]
+  return speed_mean, speed_uncertainty, speed_jitter
+
+
+def format_step_line(step: int, batch_size: int,
+                     step_train_times: Sequence[float], loss: float,
+                     top_1: float = None, top_5: float = None,
+                     lr: float = None) -> str:
+  """Per-step display line, format-compatible with the reference
+  (ref: benchmark_cnn.py:834-846)."""
+  speed_mean, speed_uncertainty, speed_jitter = get_perf_timing(
+      batch_size, step_train_times)
+  log_str = (f"{step}\timages/sec: {speed_mean:.1f} "
+             f"+/- {speed_uncertainty:.1f} (jitter = {speed_jitter:.1f})\t"
+             f"{loss:.3f}")
+  if top_1 is not None:
+    log_str += f"\t{top_1:.3f}\t{top_5:.3f}"
+  if lr is not None:
+    log_str += f"\t{lr:.5f}"
+  return log_str

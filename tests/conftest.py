@@ -5,12 +5,20 @@ XLA's host platform with 8 virtual devices, mirroring how the reference
 tests distributed modes without a real cluster (ref:
 benchmark_cnn_distributed_test.py spawns localhost processes; we use
 virtual devices instead -- SURVEY 7.1 test plan).
+
+Note: this environment pins JAX_PLATFORMS=axon via sitecustomize, and
+overriding the env var to "cpu" before interpreter start hangs the axon
+relay. The working recipe is: set XLA_FLAGS before jax import, then flip
+the platform with jax.config.update AFTER import.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
   os.environ["XLA_FLAGS"] = (
       xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (must come after XLA_FLAGS is set)
+
+jax.config.update("jax_platforms", "cpu")

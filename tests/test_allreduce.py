@@ -1,0 +1,119 @@
+"""Tests for the collectives layer: spec parsing, packing round-trips,
+planner numerics (ref: allreduce_test.py:32-446)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from kf_benchmarks_tpu.ops import allreduce
+from kf_benchmarks_tpu.parallel.mesh import build_mesh
+
+N = 8
+
+
+class TestSpecParsing:
+
+  def test_single_alg(self):
+    [t] = allreduce.parse_all_reduce_spec("psum")
+    assert t.alg == "psum" and t.shards == 1 and t.limit is None
+
+  def test_sharded_alg(self):
+    [t] = allreduce.parse_all_reduce_spec("rsag#4")
+    assert t.alg == "rsag" and t.shards == 4
+
+  def test_size_ranged_hybrid(self):
+    ts = allreduce.parse_all_reduce_spec("psum:32k:rsag")
+    assert ts[0] == allreduce.AllReduceSpecTuple("psum", 1, 32 * 1024)
+    assert ts[1] == allreduce.AllReduceSpecTuple("rsag", 1, None)
+
+  def test_reference_aliases(self):
+    [t] = allreduce.parse_all_reduce_spec("nccl")
+    assert t.alg == "psum"
+    ts = allreduce.parse_all_reduce_spec("pscpu:32k:xring")
+    assert [t.alg for t in ts] == ["psum", "rsag"]
+
+  def test_invalid_specs(self):
+    for bad in ("bogus", "psum:32k", "psum:zz:rsag", "psum:32k:rsag:16k",
+                "psum:32k:rsag:16k:hier"):
+      with pytest.raises(ValueError):
+        allreduce.parse_all_reduce_spec(bad)
+
+  def test_decreasing_limits_rejected(self):
+    with pytest.raises(ValueError, match="increasing"):
+      allreduce.parse_all_reduce_spec("psum:32k:rsag:16k:hier")
+
+
+class TestPacking:
+
+  @pytest.mark.parametrize("multiple", [1, 8])
+  def test_round_trip(self, multiple):
+    leaves = [jnp.arange(5, dtype=jnp.float32).reshape(5),
+              jnp.ones((2, 3), jnp.float32) * 2,
+              jnp.zeros((1, 1, 4), jnp.bfloat16)]
+    vec, meta = allreduce.pack_tensors(leaves, multiple_of=multiple)
+    assert vec.shape[0] % multiple == 0
+    out = allreduce.unpack_tensors(vec, meta)
+    for a, b in zip(leaves, out):
+      assert a.dtype == b.dtype and a.shape == b.shape
+      np.testing.assert_allclose(np.asarray(a, np.float32),
+                                 np.asarray(b, np.float32))
+
+
+def _planner_reduce(spec, tree):
+  mesh = build_mesh(N, "cpu")
+  planner = allreduce.CollectivePlanner(
+      allreduce.parse_all_reduce_spec(spec), num_replicas_hint=N)
+
+  def body(t):
+    per = jax.tree.map(lambda x: jnp.squeeze(x, 0), t)
+    out = planner.reduce(per, "replica")
+    return jax.tree.map(lambda x: x[None], out)
+
+  f = jax.jit(jax.shard_map(
+      body, mesh=mesh, in_specs=(P("replica"),), out_specs=P("replica")))
+  return f(tree)
+
+
+@pytest.mark.parametrize("spec", ["psum", "rsag", "hier#2", "psum:32:rsag"])
+def test_planner_computes_mean(spec):
+  # Per-replica values r on every element; mean over replicas = 3.5.
+  big = jnp.stack([jnp.full((31, 3), r, jnp.float32) for r in range(N)])
+  small = jnp.stack([jnp.full((2,), r * 2.0, jnp.float32) for r in range(N)])
+  tree = {"big": big, "small": small}
+  out = _planner_reduce(spec, tree)
+  np.testing.assert_allclose(np.asarray(out["big"]),
+                             np.full((N, 31, 3), 3.5), rtol=1e-6)
+  np.testing.assert_allclose(np.asarray(out["small"]),
+                             np.full((N, 2), 7.0), rtol=1e-6)
+
+
+def test_size_ranged_bucketing():
+  planner = allreduce.CollectivePlanner(
+      allreduce.parse_all_reduce_spec("psum:32:rsag"), num_replicas_hint=N)
+  # 4 bytes/elem: 2-elem tensor (8B) -> bucket 0; 100-elem -> bucket 1.
+  assert planner._bucket_of(8) == 0
+  assert planner._bucket_of(400) == 1
+  assert planner._bucket_of(32) == 1  # exclusive upper bound
+
+
+def test_strategy_integration():
+  """collective_all_reduce + spec end-to-end through get_strategy."""
+  from kf_benchmarks_tpu import params as params_lib
+  from kf_benchmarks_tpu.parallel import strategies
+  p = params_lib.make_params(variable_update="collective_all_reduce",
+                             all_reduce_spec="psum:32k:rsag",
+                             num_devices=N, device="cpu")
+  s = strategies.get_strategy(p)
+  assert s.planner is not None
+  mesh = build_mesh(N, "cpu")
+  vals = jnp.stack([jnp.full((17,), float(r)) for r in range(N)])
+
+  def body(v):
+    return s.reduce_gradients(jnp.squeeze(v, 0), "replica")[None]
+
+  f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(P("replica"),),
+                            out_specs=P("replica")))
+  np.testing.assert_allclose(np.asarray(f(vals)), np.full((N, 17), 3.5),
+                             rtol=1e-6)

@@ -1,0 +1,92 @@
+"""End-to-end benchmark-loop tests with log scraping.
+
+Mirrors the reference's e2e strategy: run real training through
+BenchmarkCNN.run() on tiny synthetic data and parse the printed output
+(ref: test_util.py:101-199 get_training_outputs_from_logs /
+check_training_outputs_are_reasonable, monkey-patched log_fn at
+test_util.py:38-68).
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+from kf_benchmarks_tpu import benchmark, params as params_lib
+from kf_benchmarks_tpu.utils import log as log_util
+
+STEP_RE = re.compile(
+    r"^(\d+)\timages/sec: ([\d.]+) \+/- ([\d.]+) \(jitter = ([\d.]+)\)\t"
+    r"([\d.naninf]+)")
+TOTAL_RE = re.compile(r"^total images/sec: ([\d.]+)$")
+
+
+def _run_and_scrape(**overrides):
+  logs = []
+  orig = log_util.log_fn
+  benchmark.log_fn = log_util.log_fn = logs.append
+  try:
+    defaults = dict(model="trivial", num_batches=8, num_warmup_batches=1,
+                    device="cpu", display_every=2, batch_size=4)
+    defaults.update(overrides)
+    p = params_lib.make_params(**defaults)
+    bench = benchmark.BenchmarkCNN(p)
+    stats = bench.run()
+  finally:
+    benchmark.log_fn = log_util.log_fn = orig
+  return logs, stats
+
+
+def test_train_loop_output_format():
+  logs, stats = _run_and_scrape()
+  step_lines = [m for l in logs if (m := STEP_RE.match(l))]
+  assert len(step_lines) == 4  # 8 batches, display_every=2
+  steps = [int(m.group(1)) for m in step_lines]
+  assert steps == [2, 4, 6, 8]
+  losses = [float(m.group(5)) for m in step_lines]
+  assert all(np.isfinite(losses)), losses
+  totals = [m for l in logs if (m := TOTAL_RE.match(l))]
+  assert len(totals) == 1
+  assert stats["num_steps"] == 8
+  assert stats["images_per_sec"] > 0
+  assert stats["num_workers"] == 1
+
+
+def test_train_loop_loss_decreases_on_fixed_batch():
+  """Repeated steps on one synthetic batch must reduce the loss
+  (sanity analog of ref check_training_outputs_are_reasonable)."""
+  logs, stats = _run_and_scrape(model="trivial", num_batches=30,
+                                display_every=10,
+                                init_learning_rate=0.001)
+  step_lines = [m for l in logs if (m := STEP_RE.match(l))]
+  losses = [float(m.group(5)) for m in step_lines]
+  assert losses[-1] < losses[0], losses
+
+
+def test_multi_device_kungfu_run():
+  logs, stats = _run_and_scrape(num_devices=8, variable_update="kungfu",
+                                kungfu_option="sync_sgd")
+  assert stats["images_per_sec"] > 0
+  banner = [l for l in logs if "kungfu" in l]
+  assert any("sync_sgd" in l for l in banner)
+
+
+def test_forward_only_and_eval_modes():
+  logs, stats = _run_and_scrape(eval=True, num_eval_batches=2)
+  assert "top_1_accuracy" in stats
+  assert 0.0 <= stats["top_1_accuracy"] <= 1.0
+
+
+def test_num_epochs_batch_arithmetic():
+  """(ref: benchmark_cnn_test.py:984-1003 get_num_batches_and_epochs)"""
+  p = params_lib.make_params(model="trivial", batch_size=100, device="cpu")
+  p = p._replace(num_batches=None, num_epochs=2.0)
+  bench = benchmark.BenchmarkCNN(p)
+  # imagenet synthetic: 1281167 examples; ceil(2*1281167/100)
+  assert bench.num_batches == int(np.ceil(2 * 1281167 / 100))
+
+
+def test_batch_size_default_from_model():
+  p = params_lib.make_params(model="trivial", device="cpu")
+  bench = benchmark.BenchmarkCNN(p)
+  assert bench.batch_size_per_device == 32  # trivial model default

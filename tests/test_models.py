@@ -1,0 +1,98 @@
+"""Model-graph unit tests: forward-pass shape/dtype per model.
+
+Mirrors the reference's TfCnnBenchmarksModelTest.testModel forward
+shape/type checks (ref: benchmark_cnn_test.py:74-160) plus registry tests.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kf_benchmarks_tpu.models import model_config
+
+
+def _forward(model, nclass=10, batch=2, train=True):
+  model.set_batch_size(batch)
+  rng = jax.random.PRNGKey(0)
+  images, labels = model.get_synthetic_inputs(rng, nclass)
+  module = model.make_module(nclass=nclass, phase_train=train)
+  variables = module.init({"params": rng, "dropout": rng}, images)
+  out, updates = module.apply(
+      variables, images, mutable=["batch_stats"],
+      rngs={"dropout": rng} if train else None)
+  return out, labels, variables, updates
+
+
+@pytest.mark.parametrize("name", ["trivial", "resnet50", "resnet50_v2"])
+def test_imagenet_model_forward(name):
+  model = model_config.get_model_config(name, "imagenet")
+  (logits, aux), labels, _, _ = _forward(model, nclass=10, batch=2)
+  assert logits.shape == (2, 10)
+  assert logits.dtype == jnp.float32
+  loss = model.loss_function(
+      __import__("kf_benchmarks_tpu.models.model",
+                 fromlist=["BuildNetworkResult"]).BuildNetworkResult(
+                     logits=(logits, aux)), labels)
+  assert loss.shape == () and jnp.isfinite(loss)
+
+
+@pytest.mark.parametrize("name", ["trivial", "resnet20", "resnet20_v2"])
+def test_cifar_model_forward(name):
+  model = model_config.get_model_config(name, "cifar10")
+  (logits, aux), labels, _, _ = _forward(model, nclass=10, batch=2)
+  assert logits.shape == (2, 10)
+
+
+def test_accuracy_function():
+  from kf_benchmarks_tpu.models.model import BuildNetworkResult
+  model = model_config.get_model_config("trivial", "imagenet")
+  logits = jnp.array([[5.0, 1.0, 0.0, 0.0, 0.0, 0.0],
+                      [3.0, 1.0, 5.0, 2.0, 2.0, 0.0]])
+  labels = jnp.array([0, 0])
+  acc = model.accuracy_function(
+      BuildNetworkResult(logits=(logits, None)), labels)
+  assert acc["top_1_accuracy"] == 0.5
+  assert acc["top_5_accuracy"] == 1.0
+
+
+def test_registry_rejects_unknown():
+  with pytest.raises(ValueError, match="Invalid model name"):
+    model_config.get_model_config("resnet9000", "imagenet")
+  with pytest.raises(ValueError, match="Invalid dataset"):
+    model_config.get_model_config("trivial", "mnist")
+
+
+def test_register_model():
+  sentinel = object()
+  model_config.register_model("custom_test_model", "imagenet",
+                              lambda params=None: sentinel)
+  try:
+    assert model_config.get_model_config("custom_test_model",
+                                         "imagenet") is sentinel
+    with pytest.raises(ValueError, match="already registered"):
+      model_config.register_model("custom_test_model", "imagenet",
+                                  lambda params=None: None)
+  finally:
+    del model_config._model_name_to_imagenet_model["custom_test_model"]
+
+
+def test_resnet_lr_schedule():
+  model = model_config.get_model_config("resnet50", "imagenet")
+  bs = 256
+  steps_per_epoch = 1281167 / bs
+  # During warmup (first 5 epochs) LR ramps linearly from 0.
+  lr0 = model.get_learning_rate(0, bs)
+  assert float(lr0) == 0.0
+  lr_mid = model.get_learning_rate(int(10 * steps_per_epoch), bs)
+  assert abs(float(lr_mid) - 0.1) < 1e-6
+  lr_late = model.get_learning_rate(int(65 * steps_per_epoch), bs)
+  assert abs(float(lr_late) - 0.001) < 1e-7
+
+
+def test_batch_stats_updated_in_train():
+  model = model_config.get_model_config("resnet20", "cifar10")
+  _, _, variables, updates = _forward(model, nclass=10, batch=2, train=True)
+  assert "batch_stats" in updates
+  # Running stats must move from their init values during training.
+  leaves = jax.tree_util.tree_leaves(updates["batch_stats"])
+  assert leaves
