@@ -23,9 +23,8 @@ def main():
   from kf_benchmarks_tpu.utils import log as log_util
 
   # Keep the bench quiet: route step logs to stderr so stdout carries
-  # only the JSON line.
+  # only the JSON line (benchmark.log_fn late-binds to log_util.log_fn).
   log_util.log_fn = lambda s: print(s, file=sys.stderr, flush=True)
-  benchmark.log_fn = log_util.log_fn
 
   # Probe TPU availability in a subprocess with a timeout: a wedged TPU
   # tunnel makes jax.devices() block forever in-process, which must not

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from kf_benchmarks_tpu import checkpoint
 from kf_benchmarks_tpu import learning_rate
 from kf_benchmarks_tpu import optimizers
 from kf_benchmarks_tpu import train_step as train_step_lib
@@ -199,6 +200,16 @@ class BenchmarkCNN:
         init_state,
         static_argnums=(),
         out_shardings=None)(init_rng, jnp.zeros(sample.shape, sample.dtype))
+    # Resume from the newest checkpoint if the train_dir has one; the run
+    # then executes num_batches MORE steps from the restored global step
+    # (ref: Supervisor auto-restore, benchmark_cnn.py:2122-2157).
+    if p.train_dir:
+      try:
+        path, ckpt_step = checkpoint.latest_checkpoint(p.train_dir)
+        state = checkpoint.restore_state(state, checkpoint.load_checkpoint(path))
+        log_fn(f"Restored checkpoint at global step {ckpt_step}")
+      except checkpoint.CheckpointNotFoundException:
+        pass
     # Replica-0 broadcast at start (ref: benchmark_cnn.py:2094-2100).
     state = state.replace(params=broadcast_init(state.params))
     jax.block_until_ready(state.params)
@@ -227,6 +238,8 @@ class BenchmarkCNN:
 
     step_train_times = []
     loss = float("nan")
+    stopped_early = False
+    last_save_time = time.time()
     loop_start = time.time()
     for i in range(self.num_batches):
       t0 = time.time()
@@ -241,6 +254,26 @@ class BenchmarkCNN:
         log_fn(log_util.format_step_line(
             i + 1, self.batch_size * max(self.num_workers, 1),
             step_train_times[-self.display_every:], loss, top1, top5))
+      # Periodic checkpoint by steps (ref: benchmark_cnn.py:2304-2309) or
+      # seconds (ref: Supervisor save_model_secs, :2137).
+      if p.train_dir and (
+          (p.save_model_steps and (i + 1) % p.save_model_steps == 0) or
+          (p.save_model_secs and
+           time.time() - last_save_time >= p.save_model_secs)):
+        checkpoint.save_checkpoint(p.train_dir, state, p.max_ckpts_to_keep)
+        last_save_time = time.time()
+      # Mid-training eval + early stop (ref: benchmark_cnn.py:2310-2324).
+      if (p.eval_during_training_every_n_steps and
+          (i + 1) % p.eval_during_training_every_n_steps == 0):
+        acc = eval_step(state, images, labels)
+        top1 = float(acc["top_1_accuracy"])
+        log_fn("Accuracy @ 1 = %.4f Accuracy @ 5 = %.4f [%d examples]" %
+               (top1, float(acc["top_5_accuracy"]), self.batch_size))
+        if p.stop_at_top_1_accuracy and top1 >= p.stop_at_top_1_accuracy:
+          log_fn(f"Stopping early at top-1 accuracy {top1:.4f} "
+                 f">= {p.stop_at_top_1_accuracy}")
+          stopped_early = True
+          break
     total_time = time.time() - loop_start
 
     num_steps = len(step_train_times)
@@ -250,6 +283,9 @@ class BenchmarkCNN:
     log_fn("-" * 64)
     log_fn("total images/sec: %.2f" % images_per_sec)
     log_fn("-" * 64)
+    # Final checkpoint (ref: benchmark_cnn.py:2374-2378).
+    if p.train_dir:
+      checkpoint.save_checkpoint(p.train_dir, state, p.max_ckpts_to_keep)
     if p.sync_on_finish:
       kungfu.run_barrier()
     # (ref stats dict: benchmark_cnn.py:2383-2391)
@@ -259,21 +295,13 @@ class BenchmarkCNN:
         "average_wall_time": average_wall_time,
         "images_per_sec": images_per_sec,
         "last_average_loss": loss,
+        "stopped_early": stopped_early,
         "state": state,
     }
 
-  def _run_eval(self) -> Dict[str, Any]:
-    """Single-shot eval on synthetic/injected data
-    (ref: benchmark_cnn.py:1757-1794; checkpoint-poll loop arrives with
-    the checkpoint subsystem)."""
+  def _eval_once(self, state, eval_step, images, labels) -> Dict[str, Any]:
+    """One pass over the eval batches (ref: benchmark_cnn.py:1864-1923)."""
     p = self.params
-    init_state, train_step, eval_step, broadcast_init = self._build()
-    rng = jax.random.PRNGKey(p.tf_random_seed or 0)
-    data_rng, init_rng = jax.random.split(rng)
-    images, labels = self._synthetic_global_batch(data_rng)
-    state = jax.jit(init_state)(
-        init_rng, jnp.zeros((self.batch_size_per_device,) +
-                            tuple(images.shape[1:]), images.dtype))
     num_eval = p.num_eval_batches or self.num_batches
     top1_sum = top5_sum = 0.0
     start = time.time()
@@ -288,3 +316,57 @@ class BenchmarkCNN:
     return {"top_1_accuracy": top1, "top_5_accuracy": top5,
             "eval_images_per_sec":
             num_eval * self.batch_size / max(elapsed, 1e-9)}
+
+  def _run_eval(self) -> Dict[str, Any]:
+    """Evaluation driver (ref: benchmark_cnn.py:1757-1794).
+
+    With a train_dir: poll for new checkpoints every eval_interval_secs,
+    evaluating each; terminate after a staleness window (10 polls with no
+    new checkpoint) -- the reference loops until killed and its own TODO
+    admits the missing staleness abort (ref :1774); bounding it is a
+    deliberate improvement. Without a train_dir: single-shot eval of a
+    fresh-init model on synthetic data.
+    """
+    p = self.params
+    init_state, train_step, eval_step, broadcast_init = self._build()
+    rng = jax.random.PRNGKey(p.tf_random_seed or 0)
+    data_rng, init_rng = jax.random.split(rng)
+    images, labels = self._synthetic_global_batch(data_rng)
+    state = jax.jit(init_state)(
+        init_rng, jnp.zeros((self.batch_size_per_device,) +
+                            tuple(images.shape[1:]), images.dtype))
+    if not p.train_dir:
+      return self._eval_once(state, eval_step, images, labels)
+
+    last_evaluated_step = -1
+    results = None
+    stale_polls = 0
+    max_stale_polls = 10
+    while True:
+      try:
+        path, ckpt_step = checkpoint.latest_checkpoint(p.train_dir)
+      except checkpoint.CheckpointNotFoundException:
+        # Missing checkpoints are tolerated: wait (ref :1784-1785).
+        if not p.eval_interval_secs:
+          raise
+        time.sleep(p.eval_interval_secs)
+        continue
+      if ckpt_step > last_evaluated_step:
+        try:
+          snapshot = checkpoint.load_checkpoint(path)
+        except FileNotFoundError:
+          # The trainer pruned this checkpoint between resolution and
+          # read; treat as not-yet-available and re-poll.
+          time.sleep(p.eval_interval_secs or 1)
+          continue
+        state = checkpoint.restore_state(state, snapshot)
+        log_fn(f"Evaluating checkpoint at global step {ckpt_step}")
+        results = self._eval_once(state, eval_step, images, labels)
+        results["global_step"] = ckpt_step
+        last_evaluated_step = ckpt_step
+        stale_polls = 0
+      else:
+        stale_polls += 1
+      if not p.eval_interval_secs or stale_polls >= max_stale_polls:
+        return results
+      time.sleep(p.eval_interval_secs)

@@ -1,0 +1,101 @@
+"""Checkpoint/resume tests.
+
+Mirrors the reference's save/load round-trip (testSaveLoadModel,
+benchmark_cnn_test.py:74), relocatability (testMoveTrainDir :688), and
+train->resume->eval flow (test_util.train_and_eval :202-301).
+"""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from kf_benchmarks_tpu import benchmark, checkpoint, params as params_lib
+
+
+def _train(tmp, **overrides):
+  # Zero warmup keeps global-step arithmetic exact (warmup steps advance
+  # the global step, as in the reference).
+  defaults = dict(model="trivial", num_batches=4, num_warmup_batches=0,
+                  device="cpu", batch_size=4, display_every=2,
+                  train_dir=tmp)
+  defaults.update(overrides)
+  p = params_lib.make_params(**defaults)
+  return benchmark.BenchmarkCNN(p).run(), p
+
+
+def test_save_restore_round_trip(tmp_path):
+  tmp = str(tmp_path / "train")
+  stats, p = _train(tmp)
+  path, step = checkpoint.latest_checkpoint(tmp)
+  assert step == 4
+  snap = checkpoint.load_checkpoint(path)
+  assert snap["step"] == 4
+  # Restore into a fresh state and check the params match replica 0.
+  state = stats["state"]
+  restored = checkpoint.restore_state(state, snap)
+  orig0 = np.asarray(jax_tree_leaf(state.params))
+  rest = np.asarray(jax_tree_leaf(restored.params))
+  np.testing.assert_allclose(orig0, rest, rtol=1e-6)
+  assert int(restored.step) == 4
+
+
+def jax_tree_leaf(tree):
+  import jax
+  return jax.tree.leaves(tree)[0]
+
+
+def test_resume_continues_from_checkpoint(tmp_path):
+  tmp = str(tmp_path / "train")
+  _train(tmp, num_batches=3)
+  logs = []
+  from kf_benchmarks_tpu.utils import log as log_util
+  orig = log_util.log_fn
+  log_util.log_fn = logs.append
+  try:
+    stats, _ = _train(tmp, num_batches=2)
+  finally:
+    log_util.log_fn = orig
+  assert any("Restored checkpoint at global step 3" in l for l in logs)
+  _, step = checkpoint.latest_checkpoint(tmp)
+  assert step == 5  # 3 + 2 more
+
+
+def test_move_train_dir(tmp_path):
+  """(ref: benchmark_cnn_test.py:688 testMoveTrainDir)"""
+  tmp = str(tmp_path / "train")
+  _train(tmp)
+  moved = str(tmp_path / "moved")
+  shutil.move(tmp, moved)
+  path, step = checkpoint.latest_checkpoint(moved)
+  assert step == 4
+  snap = checkpoint.load_checkpoint(path)
+  assert snap["step"] == 4
+
+
+def test_max_ckpts_to_keep(tmp_path):
+  tmp = str(tmp_path / "train")
+  _train(tmp, num_batches=6, save_model_steps=1, max_ckpts_to_keep=2)
+  ckpts = checkpoint.all_checkpoints(tmp)
+  assert len(ckpts) == 2
+  assert ckpts[-1][0] == 6
+
+
+def test_eval_reads_checkpoint(tmp_path):
+  tmp = str(tmp_path / "train")
+  _train(tmp)
+  stats, _ = _train(tmp, eval=True, num_eval_batches=2, num_batches=None)
+  assert stats["global_step"] == 4
+  assert 0.0 <= stats["top_1_accuracy"] <= 1.0
+
+
+def test_eval_without_checkpoint_raises(tmp_path):
+  with pytest.raises(checkpoint.CheckpointNotFoundException):
+    _train(str(tmp_path / "empty"), eval=True, num_eval_batches=1,
+           num_batches=None, save_model_steps=0)
+
+
+def test_missing_dir_raises():
+  with pytest.raises(checkpoint.CheckpointNotFoundException):
+    checkpoint.latest_checkpoint("/nonexistent/dir")

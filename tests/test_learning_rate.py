@@ -1,0 +1,60 @@
+"""LR schedule tests (ref: benchmark_cnn_test.py:888-1003
+_test_learning_rate table tests)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kf_benchmarks_tpu import learning_rate, params as params_lib
+from kf_benchmarks_tpu.models import model_config
+
+
+def _lr_fn(num_examples=1000, **overrides):
+  p = params_lib.make_params(**overrides)
+  model = model_config.get_model_config("trivial", "imagenet")
+  return learning_rate.make_learning_rate_fn(p, model, batch_size=10,
+                                             num_examples_per_epoch=num_examples)
+
+
+def test_parse_piecewise():
+  values, bounds = learning_rate.parse_piecewise_schedule("0.1;10;0.01;20;0.001")
+  np.testing.assert_allclose(values, [0.1, 0.01, 0.001])
+  np.testing.assert_allclose(bounds, [10, 20])
+
+
+@pytest.mark.parametrize("bad", ["0.1;10", "0.1;ten;0.01", "0.1;20;0.01;10;0.001",
+                                 "0.1;0;0.01"])
+def test_parse_piecewise_invalid(bad):
+  with pytest.raises(ValueError):
+    learning_rate.parse_piecewise_schedule(bad)
+
+
+def test_piecewise_boundaries():
+  # 1000 examples / batch 10 = 100 steps per epoch; boundaries at epochs 1, 2.
+  fn = _lr_fn(piecewise_learning_rate_schedule="0.5;1;0.05;2;0.005")
+  for step, expected in [(0, 0.5), (99, 0.5), (100, 0.05), (199, 0.05),
+                         (200, 0.005)]:
+    assert float(fn(step)) == pytest.approx(expected, rel=1e-6)
+
+
+def test_exponential_decay_with_floor():
+  fn = _lr_fn(init_learning_rate=1.0, num_epochs_per_decay=1.0,
+              learning_rate_decay_factor=0.1, minimum_learning_rate=0.005)
+  assert float(fn(0)) == 1.0
+  assert abs(float(fn(100)) - 0.1) < 1e-7
+  assert abs(float(fn(200)) - 0.01) < 1e-8
+  assert abs(float(fn(300)) - 0.005) < 1e-8  # floored
+
+
+def test_warmup_ramp():
+  fn = _lr_fn(init_learning_rate=0.8, num_learning_rate_warmup_epochs=2.0)
+  # warmup over 200 steps, linear from 0.
+  assert float(fn(0)) == 0.0
+  assert abs(float(fn(100)) - 0.4) < 1e-6
+  assert abs(float(fn(200)) - 0.8) < 1e-6
+  assert abs(float(fn(500)) - 0.8) < 1e-6
+
+
+def test_model_default_fallback():
+  fn = _lr_fn()  # no LR flags: trivial model default 0.005
+  assert abs(float(fn(0)) - 0.005) < 1e-9
