@@ -346,9 +346,14 @@ class BenchmarkCNN:
       try:
         path, ckpt_step = checkpoint.latest_checkpoint(p.train_dir)
       except checkpoint.CheckpointNotFoundException:
-        # Missing checkpoints are tolerated: wait (ref :1784-1785).
+        # Missing checkpoints are tolerated: wait (ref :1784-1785), but a
+        # never-appearing checkpoint still counts toward the staleness
+        # bound so the poll loop cannot spin forever.
         if not p.eval_interval_secs:
           raise
+        stale_polls += 1
+        if stale_polls >= max_stale_polls:
+          return results
         time.sleep(p.eval_interval_secs)
         continue
       if ckpt_step > last_evaluated_step:
@@ -357,6 +362,9 @@ class BenchmarkCNN:
         except FileNotFoundError:
           # The trainer pruned this checkpoint between resolution and
           # read; treat as not-yet-available and re-poll.
+          stale_polls += 1
+          if stale_polls >= max_stale_polls:
+            return results
           time.sleep(p.eval_interval_secs or 1)
           continue
         state = checkpoint.restore_state(state, snapshot)

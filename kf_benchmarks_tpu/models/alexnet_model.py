@@ -1,0 +1,64 @@
+"""AlexNet model configurations (ref: models/alexnet_model.py).
+
+Krizhevsky, Sutskever, Hinton, "ImageNet Classification with Deep
+Convolutional Neural Networks" (NeurIPS 2012); the cifar variant follows
+the TF cifar10 tutorial model.
+"""
+
+import jax.numpy as jnp
+
+from kf_benchmarks_tpu.models import model
+
+
+class AlexnetModel(model.CNNModel):
+  """(ref: models/alexnet_model.py:27-49)"""
+
+  def __init__(self, params=None):
+    # 224 + 3: VALID convs require the images padded by 3 in H and W.
+    super().__init__("alexnet", 224 + 3, 512, 0.005, params=params)
+
+  def add_inference(self, cnn):
+    cnn.conv(64, 11, 11, 4, 4, "VALID")
+    cnn.mpool(3, 3, 2, 2)
+    cnn.conv(192, 5, 5)
+    cnn.mpool(3, 3, 2, 2)
+    cnn.conv(384, 3, 3)
+    cnn.conv(384, 3, 3)
+    cnn.conv(256, 3, 3)
+    cnn.mpool(3, 3, 2, 2)
+    cnn.reshape([-1, 256 * 6 * 6])
+    cnn.affine(4096)
+    cnn.dropout()
+    cnn.affine(4096)
+    cnn.dropout()
+
+
+class AlexnetCifar10Model(model.CNNModel):
+  """Cifar-sized AlexNet from the TF tutorial (ref: models/alexnet_model.py:52-92)."""
+
+  def __init__(self, params=None):
+    super().__init__("alexnet", 32, 128, 0.1, params=params)
+
+  def add_inference(self, cnn):
+    cnn.conv(64, 5, 5, 1, 1, "SAME", stddev=5e-2)
+    cnn.mpool(3, 3, 2, 2, mode="SAME")
+    cnn.lrn(depth_radius=4, bias=1.0, alpha=0.001 / 9.0, beta=0.75)
+    cnn.conv(64, 5, 5, 1, 1, "SAME", bias=0.1, stddev=5e-2)
+    cnn.lrn(depth_radius=4, bias=1.0, alpha=0.001 / 9.0, beta=0.75)
+    cnn.mpool(3, 3, 2, 2, mode="SAME")
+    shape = cnn.top_layer.shape
+    flat_dim = shape[1] * shape[2] * shape[3]
+    cnn.reshape([-1, flat_dim])
+    cnn.affine(384, stddev=0.04, bias=0.1)
+    cnn.affine(192, stddev=0.04, bias=0.1)
+
+  def get_learning_rate(self, global_step, batch_size):
+    """Staircase exponential decay, 0.1x every 100 epochs
+    (ref: models/alexnet_model.py:80-92)."""
+    num_examples_per_epoch = 50000
+    num_epochs_per_decay = 100
+    decay_steps = int(num_epochs_per_decay * num_examples_per_epoch
+                      / batch_size)
+    num_decays = jnp.floor(jnp.asarray(global_step, jnp.float32)
+                           / decay_steps)
+    return self.learning_rate * jnp.power(0.1, num_decays)

@@ -114,8 +114,10 @@ class ConvNetBuilder:
     use_bn = self.use_batch_norm if use_batch_norm is None else use_batch_norm
     if kernel_initializer is None:
       if stddev is None:
+        # Glorot uniform, the Keras Conv2D default the reference inherits
+        # (ref: convnet_builder.py:107-113 keras Conv2D w/o initializer).
         kernel_initializer = nn.initializers.variance_scaling(
-            2.0, "fan_in", "truncated_normal")
+            1.0, "fan_avg", "uniform")
       else:
         kernel_initializer = nn.initializers.truncated_normal(stddev=stddev)
     x = self._spatial(jnp.asarray(input_layer, self.dtype))
@@ -151,6 +153,10 @@ class ConvNetBuilder:
             d_width: int, mode: str, input_layer, name: Optional[str]):
     if input_layer is None:
       input_layer = self.top_layer
+    else:
+      # Pooling keeps channel count; re-anchor top_size to the explicit
+      # input (ref: convnet_builder.py:215-230 num_channels_in handling).
+      self.top_size = int(input_layer.shape[self.channel_axis])
     name = name or self._name(pool)
     x = self._spatial(input_layer)
     window = (1, k_height, k_width, 1)
@@ -170,14 +176,16 @@ class ConvNetBuilder:
     return x
 
   def mpool(self, k_height, k_width, d_height=2, d_width=2, mode="VALID",
-            input_layer=None, name=None):
+            input_layer=None, num_channels_in=None, name=None):
     """Max pool (ref: convnet_builder.py:243-254)."""
+    del num_channels_in  # channel count inferred from the input's shape
     return self._pool("mpool", k_height, k_width, d_height, d_width, mode,
                       input_layer, name)
 
   def apool(self, k_height, k_width, d_height=2, d_width=2, mode="VALID",
-            input_layer=None, name=None):
+            input_layer=None, num_channels_in=None, name=None):
     """Average pool (ref: convnet_builder.py:256-266)."""
+    del num_channels_in
     return self._pool("apool", k_height, k_width, d_height, d_width, mode,
                       input_layer, name)
 
@@ -202,10 +210,12 @@ class ConvNetBuilder:
     if x.ndim > 2:
       x = jnp.reshape(x, (x.shape[0], -1))
     if stddev is None:
-      kernel_init = nn.initializers.variance_scaling(
-          1.0, "fan_avg", "uniform")  # glorot, the TF dense default
-    else:
-      kernel_init = nn.initializers.truncated_normal(stddev=stddev)
+      # He-style fan-in truncated normal, matching the reference's affine
+      # default: sqrt(init_factor / num_channels_in), init_factor 2 for
+      # relu else 1 (ref: convnet_builder.py affine).
+      init_factor = 2.0 if activation == "relu" else 1.0
+      stddev = float(init_factor / int(x.shape[-1])) ** 0.5
+    kernel_init = nn.initializers.truncated_normal(stddev=stddev)
     x = nn.Dense(features=num_out_channels,
                  kernel_init=kernel_init,
                  bias_init=nn.initializers.constant(bias),
@@ -218,30 +228,37 @@ class ConvNetBuilder:
     return x
 
   def inception_module(self, name: str, cols: Sequence[Sequence]):
-    """Column-parallel spec interpreter (ref: convnet_builder.py:347-384).
+    """Column-parallel spec interpreter (ref: convnet_builder.py:347-382).
 
     Each column is a list of (op_name, *args) tuples over ops of this
-    builder; column outputs are concatenated on the channel axis.
+    builder; column outputs are concatenated on the channel axis. A
+    ``('share',)`` entry reuses the previous column's layer at the same
+    depth index (enabling split-then-branch structures like Inception
+    v3's mixed_9/10 blocks).
     """
     start_layer = self.top_layer
     start_size = self.top_size
-    col_outputs = []
-    col_sizes = []
+    col_layers: list = []
+    col_sizes: list = []
     for c, column in enumerate(cols):
-      self.top_layer = start_layer
-      self.top_size = start_size
-      for op_spec in column:
+      col_layers.append([])
+      col_sizes.append([])
+      for l, op_spec in enumerate(column):
         op_name, args = op_spec[0], op_spec[1:]
+        kwargs = {"input_layer": start_layer} if l == 0 else {}
         if op_name == "share":
-          # Share the previous column's output so far (ref :366-370).
-          self.top_layer = col_outputs[-1]
-          self.top_size = col_sizes[-1]
-          continue
-        getattr(self, op_name)(*args)
-      col_outputs.append(self.top_layer)
-      col_sizes.append(self.top_size)
-    self.top_layer = jnp.concatenate(col_outputs, axis=self.channel_axis)
-    self.top_size = sum(col_sizes)
+          self.top_layer = col_layers[c - 1][l]
+          self.top_size = col_sizes[c - 1][l]
+        elif op_name in ("conv", "mpool", "apool"):
+          getattr(self, op_name)(*args, **kwargs)
+        else:
+          raise KeyError(
+              f"Invalid layer type for inception module: {op_name!r}")
+        col_layers[c].append(self.top_layer)
+        col_sizes[c].append(self.top_size)
+    self.top_layer = jnp.concatenate([layers[-1] for layers in col_layers],
+                                     axis=self.channel_axis)
+    self.top_size = sum(sizes[-1] for sizes in col_sizes)
     return self.top_layer
 
   def spatial_mean(self, keep_dims: bool = False, input_layer=None):

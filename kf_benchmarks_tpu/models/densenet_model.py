@@ -1,0 +1,88 @@
+"""DenseNet model configurations, cifar-sized (ref: models/densenet_model.py).
+
+Huang et al., "Densely Connected Convolutional Networks"
+(arXiv:1608.06993).
+"""
+
+import math
+
+import jax.nn
+import jax.numpy as jnp
+
+from kf_benchmarks_tpu.models import model as model_lib
+
+
+class DensenetCifar10Model(model_lib.CNNModel):
+  """Densenet for cifar10 (ref: models/densenet_model.py:27-85)."""
+
+  def __init__(self, model, layer_counts, growth_rate, params=None):
+    self.growth_rate = growth_rate
+    super().__init__(model, 32, 64, 0.1, layer_counts=layer_counts,
+                     params=params)
+    self.batch_norm_config = {"decay": 0.9, "epsilon": 1e-5, "scale": True}
+
+  def dense_block(self, cnn, growth_rate):
+    """BN -> relu -> 3x3 conv, concatenated onto the input
+    (ref: models/densenet_model.py:36-44)."""
+    input_layer = cnn.top_layer
+    c = cnn.batch_norm(input_layer, **self.batch_norm_config)
+    c = jax.nn.relu(c)
+    c = cnn.conv(growth_rate, 3, 3, 1, 1,
+                 stddev=math.sqrt(2.0 / 9 / growth_rate),
+                 activation=None, input_layer=c)
+    cnn.top_layer = jnp.concatenate([input_layer, c], cnn.channel_axis)
+    cnn.top_size += growth_rate
+
+  def transition_layer(self, cnn):
+    """BN -> relu -> 1x1 conv -> 2x2 avg pool (ref :46-51)."""
+    in_size = cnn.top_size
+    cnn.batch_norm(**self.batch_norm_config)
+    cnn.top_layer = jax.nn.relu(cnn.top_layer)
+    cnn.conv(in_size, 1, 1, 1, 1, stddev=math.sqrt(2.0 / 9 / in_size))
+    cnn.apool(2, 2, 2, 2)
+
+  def add_inference(self, cnn):
+    if self.layer_counts is None:
+      raise ValueError(f"Layer counts not specified for {self.get_name()}")
+    if self.growth_rate is None:
+      raise ValueError(f"Growth rate not specified for {self.get_name()}")
+
+    cnn.conv(16, 3, 3, 1, 1, activation=None)
+    for _ in range(self.layer_counts[0]):
+      self.dense_block(cnn, self.growth_rate)
+    self.transition_layer(cnn)
+    for _ in range(self.layer_counts[1]):
+      self.dense_block(cnn, self.growth_rate)
+    self.transition_layer(cnn)
+    for _ in range(self.layer_counts[2]):
+      self.dense_block(cnn, self.growth_rate)
+    cnn.batch_norm(**self.batch_norm_config)
+    cnn.top_layer = jax.nn.relu(cnn.top_layer)
+    cnn.top_size = cnn.top_layer.shape[cnn.channel_axis]
+    cnn.spatial_mean()
+
+  def get_learning_rate(self, global_step, batch_size):
+    """Piecewise 0.1/0.01/0.001/0.0001 at epochs 150/225/300
+    (ref: models/densenet_model.py:78-85)."""
+    num_batches_per_epoch = int(50000 / batch_size)
+    step = jnp.asarray(global_step, jnp.int32)
+    lr = jnp.asarray(0.1, jnp.float32)
+    for epoch, value in zip((150, 225, 300), (0.01, 0.001, 0.0001)):
+      lr = jnp.where(step >= epoch * num_batches_per_epoch,
+                     jnp.asarray(value, jnp.float32), lr)
+    return lr
+
+
+def create_densenet40_k12_model(params=None):
+  return DensenetCifar10Model("densenet40_k12", (12, 12, 12), 12,
+                              params=params)
+
+
+def create_densenet100_k12_model(params=None):
+  return DensenetCifar10Model("densenet100_k12", (32, 32, 32), 12,
+                              params=params)
+
+
+def create_densenet100_k24_model(params=None):
+  return DensenetCifar10Model("densenet100_k24", (32, 32, 32), 24,
+                              params=params)

@@ -166,8 +166,9 @@ class CNNModel(Model):
     """Truncated-normal device-resident synthetic batch (ref :220-237)."""
     image_shape, label_shape = self.get_input_shapes("train")
     r_img, r_lbl = jax.random.split(rng)
+    # Within [0, 255]: mean 127, stddev 60 (ref: models/model.py:220-237).
     images = jax.random.truncated_normal(
-        r_img, -2.0, 2.0, image_shape, jnp.float32) * 0.5 + 127.0
+        r_img, -2.0, 2.0, image_shape, jnp.float32) * 60.0 + 127.0
     labels = jax.random.randint(r_lbl, label_shape, 0, nclass, jnp.int32)
     return images, labels
 

@@ -11,11 +11,27 @@ from __future__ import annotations
 
 from typing import Callable, Dict, Optional
 
+from kf_benchmarks_tpu.models import alexnet_model
+from kf_benchmarks_tpu.models import densenet_model
+from kf_benchmarks_tpu.models import googlenet_model
+from kf_benchmarks_tpu.models import inception_model
+from kf_benchmarks_tpu.models import lenet_model
+from kf_benchmarks_tpu.models import overfeat_model
 from kf_benchmarks_tpu.models import resnet_model
 from kf_benchmarks_tpu.models import trivial_model
+from kf_benchmarks_tpu.models import vgg_model
 
 _model_name_to_imagenet_model: Dict[str, Callable] = {
+    "vgg11": vgg_model.Vgg11Model,
+    "vgg16": vgg_model.Vgg16Model,
+    "vgg19": vgg_model.Vgg19Model,
+    "lenet": lenet_model.Lenet5Model,
+    "googlenet": googlenet_model.GooglenetModel,
+    "overfeat": overfeat_model.OverfeatModel,
+    "alexnet": alexnet_model.AlexnetModel,
     "trivial": trivial_model.TrivialModel,
+    "inception3": inception_model.Inceptionv3Model,
+    "inception4": inception_model.Inceptionv4Model,
     "resnet50": resnet_model.create_resnet50_model,
     "resnet50_v1.5": resnet_model.create_resnet50_v15_model,
     "resnet50_v2": resnet_model.create_resnet50_v2_model,
@@ -26,7 +42,11 @@ _model_name_to_imagenet_model: Dict[str, Callable] = {
 }
 
 _model_name_to_cifar_model: Dict[str, Callable] = {
+    "alexnet": alexnet_model.AlexnetCifar10Model,
     "trivial": trivial_model.TrivialCifar10Model,
+    "densenet40_k12": densenet_model.create_densenet40_k12_model,
+    "densenet100_k12": densenet_model.create_densenet100_k12_model,
+    "densenet100_k24": densenet_model.create_densenet100_k24_model,
     "resnet20": resnet_model.create_resnet20_cifar_model,
     "resnet20_v2": resnet_model.create_resnet20_v2_cifar_model,
     "resnet32": resnet_model.create_resnet32_cifar_model,

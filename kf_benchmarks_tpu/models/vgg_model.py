@@ -1,0 +1,53 @@
+"""VGG model configurations (ref: models/vgg_model.py).
+
+vgg11/vgg16/vgg19 are models A, D, E from Simonyan & Zisserman,
+"Very Deep Convolutional Networks for Large-Scale Image Recognition"
+(arXiv:1409.1556).
+"""
+
+from kf_benchmarks_tpu.models import model
+
+
+def _construct_vgg(cnn, num_conv_layers):
+  """Five conv blocks with doubling widths, then two FC+dropout stages
+  (ref: models/vgg_model.py:30-52)."""
+  assert len(num_conv_layers) == 5
+  for channels, count in zip((64, 128, 256, 512, 512), num_conv_layers):
+    for _ in range(count):
+      cnn.conv(channels, 3, 3)
+    cnn.mpool(2, 2)
+  cnn.reshape([-1, 512 * 7 * 7])
+  cnn.affine(4096)
+  cnn.dropout()
+  cnn.affine(4096)
+  cnn.dropout()
+
+
+class Vgg11Model(model.CNNModel):
+  """(ref: models/vgg_model.py:55-62)"""
+
+  def __init__(self, params=None):
+    super().__init__("vgg11", 224, 64, 0.005, params=params)
+
+  def add_inference(self, cnn):
+    _construct_vgg(cnn, [1, 1, 2, 2, 2])
+
+
+class Vgg16Model(model.CNNModel):
+  """(ref: models/vgg_model.py:65-71)"""
+
+  def __init__(self, params=None):
+    super().__init__("vgg16", 224, 64, 0.005, params=params)
+
+  def add_inference(self, cnn):
+    _construct_vgg(cnn, [2, 2, 3, 3, 3])
+
+
+class Vgg19Model(model.CNNModel):
+  """(ref: models/vgg_model.py:74-80)"""
+
+  def __init__(self, params=None):
+    super().__init__("vgg19", 224, 64, 0.005, params=params)
+
+  def add_inference(self, cnn):
+    _construct_vgg(cnn, [2, 2, 4, 4, 4])

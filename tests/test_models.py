@@ -23,7 +23,10 @@ def _forward(model, nclass=10, batch=2, train=True):
   return out, labels, variables, updates
 
 
-@pytest.mark.parametrize("name", ["trivial", "resnet50", "resnet50_v2"])
+@pytest.mark.parametrize("name", [
+    "trivial", "resnet50", "resnet50_v2", "vgg11", "vgg16", "vgg19",
+    "lenet", "googlenet", "overfeat", "alexnet", "inception3", "inception4",
+])
 def test_imagenet_model_forward(name):
   model = model_config.get_model_config(name, "imagenet")
   (logits, aux), labels, _, _ = _forward(model, nclass=10, batch=2)
@@ -36,11 +39,47 @@ def test_imagenet_model_forward(name):
   assert loss.shape == () and jnp.isfinite(loss)
 
 
-@pytest.mark.parametrize("name", ["trivial", "resnet20", "resnet20_v2"])
+@pytest.mark.parametrize("name", [
+    "trivial", "resnet20", "resnet20_v2", "alexnet", "densenet40_k12",
+])
 def test_cifar_model_forward(name):
   model = model_config.get_model_config(name, "cifar10")
   (logits, aux), labels, _, _ = _forward(model, nclass=10, batch=2)
   assert logits.shape == (2, 10)
+
+
+def test_inception3_aux_head():
+  """The auxiliary head produces aux logits and a 0.4-weighted loss
+  contribution (ref: models/model.py:297-302, inception_model.py:95-104)."""
+  from kf_benchmarks_tpu.models import inception_model
+  from kf_benchmarks_tpu.models.model import BuildNetworkResult
+  model = inception_model.Inceptionv3Model(auxiliary=True)
+  (logits, aux), labels, _, _ = _forward(model, nclass=10, batch=2)
+  assert logits.shape == (2, 10)
+  assert aux is not None and aux.shape == (2, 10)
+  loss_with_aux = model.loss_function(
+      BuildNetworkResult(logits=(logits, aux)), labels)
+  loss_no_aux = model.loss_function(
+      BuildNetworkResult(logits=(logits, None)), labels)
+  assert float(loss_with_aux) > float(loss_no_aux)
+
+
+def test_model_default_lr_schedules():
+  """Model-default LR schedule hooks (alexnet-cifar exponential decay,
+  densenet piecewise; ref: models/alexnet_model.py:80-92,
+  densenet_model.py:78-85)."""
+  alexnet = model_config.get_model_config("alexnet", "cifar10")
+  assert abs(float(alexnet.get_learning_rate(0, 128)) - 0.1) < 1e-7
+  decay_steps = int(100 * 50000 / 128)
+  assert abs(float(alexnet.get_learning_rate(decay_steps, 128)) - 0.01) < 1e-7
+
+  densenet = model_config.get_model_config("densenet40_k12", "cifar10")
+  batches_per_epoch = int(50000 / 64)
+  assert abs(float(densenet.get_learning_rate(0, 64)) - 0.1) < 1e-7
+  assert abs(float(densenet.get_learning_rate(
+      151 * batches_per_epoch, 64)) - 0.01) < 1e-7
+  assert abs(float(densenet.get_learning_rate(
+      301 * batches_per_epoch, 64)) - 0.0001) < 1e-8
 
 
 def test_accuracy_function():
