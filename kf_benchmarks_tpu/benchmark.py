@@ -174,6 +174,45 @@ class BenchmarkCNN:
     return (jax.device_put(global_images, batch_sharding),
             jax.device_put(global_labels, batch_sharding))
 
+  def _input_iterator(self, rng, subset: str = "train"):
+    """Per-step input source.
+
+    Synthetic (no data_dir): one device-resident batch reused every step
+    (ref: benchmark_cnn.py:3008-3011). Real data: preprocessor host
+    pipeline + double-buffered DeviceFeeder (the StagingArea/
+    MultiDeviceIterator analog, ref: benchmark_cnn.py:2572-2600).
+    Returns (next_fn, stop_fn).
+    """
+    if self.dataset.use_synthetic_gpu_inputs():
+      batch = self._synthetic_global_batch(rng)
+      return (lambda: batch), (lambda: None)
+    from kf_benchmarks_tpu.data import device_feed
+    p = self.params
+    pre = self.dataset.get_input_preprocessor(p.input_preprocessor)
+    if isinstance(pre, type):
+      shape = self._model_image_shape()
+      pre = pre(
+          batch_size=self.batch_size,
+          output_shape=shape,
+          train=(subset == "train") and not (p.eval or p.forward_only),
+          distortions=bool(p.distortions),
+          resize_method=p.resize_method,
+          seed=(p.tf_random_seed or 301) + kungfu.current_rank(),
+          shift_ratio=(kungfu.current_rank() /
+                       max(kungfu.current_cluster_size(), 1)),
+          num_threads=p.datasets_num_private_threads or 8)
+    feeder = device_feed.DeviceFeeder(
+        pre.minibatches(self.dataset, subset),
+        mesh_lib.batch_sharding(self.mesh))
+    it = iter(feeder)
+    return (lambda: next(it)), feeder.stop
+
+  def _model_image_shape(self):
+    """(H, W, C) the model consumes, from its input spec."""
+    self.model.set_batch_size(self.batch_size_per_device)
+    image_shape = self.model.get_input_shapes("train")[0]
+    return tuple(image_shape[1:])
+
   # -- run -----------------------------------------------------------------
 
   def run(self) -> Dict[str, Any]:
@@ -188,7 +227,17 @@ class BenchmarkCNN:
     init_state, train_step, eval_step, broadcast_init = self._build()
     rng = jax.random.PRNGKey(p.tf_random_seed or 0)
     data_rng, init_rng = jax.random.split(rng)
-    images, labels = self._synthetic_global_batch(data_rng)
+    next_batch, stop_input = self._input_iterator(data_rng, "train")
+    try:
+      return self._train_loop(init_state, train_step, eval_step,
+                              broadcast_init, init_rng, next_batch)
+    finally:
+      stop_input()
+
+  def _train_loop(self, init_state, train_step, eval_step, broadcast_init,
+                  init_rng, next_batch) -> Dict[str, Any]:
+    p = self.params
+    images, labels = next_batch()
 
     sample = jax.ShapeDtypeStruct(
         (self.batch_size_per_device,) + tuple(images.shape[1:]),
@@ -228,6 +277,7 @@ class BenchmarkCNN:
     for _ in range(self.num_warmup_batches):
       state, metrics = run_step(state, images, labels)
       jax.block_until_ready(metrics["total_loss"])
+      images, labels = next_batch()
     log_fn("Warmup (compile + %d steps): %.1f s" %
            (self.num_warmup_batches, time.time() - t0))
 
@@ -245,6 +295,7 @@ class BenchmarkCNN:
       t0 = time.time()
       state, metrics = run_step(state, images, labels)
       loss = float(metrics[p.loss_type_to_report])  # sync point, as sess.run
+      images, labels = next_batch()
       step_train_times.append(time.time() - t0)
       if (i + 1) % self.display_every == 0 or (i + 1) == self.num_batches:
         top1 = (float(metrics["top_1_accuracy"])
@@ -299,7 +350,8 @@ class BenchmarkCNN:
         "state": state,
     }
 
-  def _eval_once(self, state, eval_step, images, labels) -> Dict[str, Any]:
+  def _eval_once(self, state, eval_step, images, labels,
+                 next_batch=None) -> Dict[str, Any]:
     """One pass over the eval batches (ref: benchmark_cnn.py:1864-1923)."""
     p = self.params
     num_eval = p.num_eval_batches or self.num_batches
@@ -309,6 +361,8 @@ class BenchmarkCNN:
       acc = eval_step(state, images, labels)
       top1_sum += float(acc["top_1_accuracy"])
       top5_sum += float(acc["top_5_accuracy"])
+      if next_batch is not None:
+        images, labels = next_batch()
     elapsed = time.time() - start
     top1, top5 = top1_sum / num_eval, top5_sum / num_eval
     log_fn("Accuracy @ 1 = %.4f Accuracy @ 5 = %.4f [%d examples]" %
@@ -331,13 +385,27 @@ class BenchmarkCNN:
     init_state, train_step, eval_step, broadcast_init = self._build()
     rng = jax.random.PRNGKey(p.tf_random_seed or 0)
     data_rng, init_rng = jax.random.split(rng)
-    images, labels = self._synthetic_global_batch(data_rng)
+    next_batch, stop_input = self._input_iterator(data_rng, "validation")
+    images, labels = next_batch()
     state = jax.jit(init_state)(
         init_rng, jnp.zeros((self.batch_size_per_device,) +
                             tuple(images.shape[1:]), images.dtype))
+    real_data = not self.dataset.use_synthetic_gpu_inputs()
+    eval_feed = next_batch if real_data else None
     if not p.train_dir:
-      return self._eval_once(state, eval_step, images, labels)
+      try:
+        return self._eval_once(state, eval_step, images, labels, eval_feed)
+      finally:
+        stop_input()
 
+    try:
+      return self._eval_poll_loop(
+          state, eval_step, images, labels, eval_feed)
+    finally:
+      stop_input()
+
+  def _eval_poll_loop(self, state, eval_step, images, labels, eval_feed):
+    p = self.params
     last_evaluated_step = -1
     results = None
     stale_polls = 0
@@ -369,7 +437,8 @@ class BenchmarkCNN:
           continue
         state = checkpoint.restore_state(state, snapshot)
         log_fn(f"Evaluating checkpoint at global step {ckpt_step}")
-        results = self._eval_once(state, eval_step, images, labels)
+        results = self._eval_once(state, eval_step, images, labels,
+                                  eval_feed)
         results["global_step"] = ckpt_step
         last_evaluated_step = ckpt_step
         stale_polls = 0

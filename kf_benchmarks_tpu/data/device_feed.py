@@ -1,0 +1,80 @@
+"""Double-buffered host->device input feed.
+
+The analog of the reference's per-device StagingArea / MultiDeviceIterator
+prefetch chain (ref: scripts/tf_cnn_benchmarks/benchmark_cnn.py:2572-2600
+CPU staging, :2993-3006 gpu_compute_stage H2D boundary;
+preprocessing.py:368-399 MultiDeviceIterator): a background thread pulls
+host batches from the preprocessor iterator and ``jax.device_put``s them
+onto the global batch sharding ahead of the step loop, so the H2D copy
+overlaps the previous step's compute.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional, Tuple
+
+import jax
+
+
+class DeviceFeeder:
+  """Prefetching device-transfer iterator (depth-``prefetch`` pipeline)."""
+
+  def __init__(self, host_iterator: Iterator, sharding,
+               prefetch: int = 2):
+    self._host_iterator = host_iterator
+    self._sharding = sharding
+    self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, prefetch))
+    self._stop = threading.Event()
+    self._error: Optional[BaseException] = None
+    self._thread = threading.Thread(target=self._worker, daemon=True,
+                                    name="device-feeder")
+    self._thread.start()
+
+  def _worker(self) -> None:
+    try:
+      for batch in self._host_iterator:
+        if self._stop.is_set():
+          return
+        device_batch = jax.tree.map(
+            lambda x: jax.device_put(x, self._sharding), batch)
+        while not self._stop.is_set():
+          try:
+            self._queue.put(device_batch, timeout=0.5)
+            break
+          except queue.Full:
+            continue
+      self._queue.put(None)
+    except BaseException as e:  # surfaced on the consumer side
+      self._error = e
+
+  def __iter__(self):
+    return self
+
+  def __next__(self):
+    # Poll with a timeout so a worker error is surfaced even when the
+    # queue is full at error time and the sentinel could not be enqueued.
+    while True:
+      try:
+        item = self._queue.get(timeout=0.5)
+        break
+      except queue.Empty:
+        if self._error is not None:
+          raise self._error
+        if not self._thread.is_alive():
+          raise StopIteration
+    if item is None:
+      if self._error is not None:
+        raise self._error
+      raise StopIteration
+    return item
+
+  def stop(self) -> None:
+    self._stop.set()
+    # Drain so the worker unblocks.
+    try:
+      while True:
+        self._queue.get_nowait()
+    except queue.Empty:
+      pass

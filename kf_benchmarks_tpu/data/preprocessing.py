@@ -1,0 +1,375 @@
+"""Host-side input preprocessing (real-data pipeline).
+
+TPU-native re-design of the reference's input layer (ref:
+scripts/tf_cnn_benchmarks/preprocessing.py). The reference builds tf.data /
+RecordInput graphs with per-device StagingAreas; here the host pipeline is
+plain Python/numpy/PIL running in a thread pool, and device transfer is a
+double-buffered ``jax.device_put`` onto the batch sharding (the
+MultiDeviceIterator / gpu_compute_stage analog lives in device_feed.py).
+
+Semantics preserved from the reference:
+
+* final images are float32 in [-1, 1]: ``x / 127.5 - 1``
+  (ref: preprocessing.py:130-133 normalized_image)
+* train: sampled distorted bbox crop (min_object_covered=0.1, aspect
+  [0.75, 1.33], area [0.05, 1.0], 100 attempts), resize with per-position
+  round-robin method, random horizontal flip, optional color distortion
+  (ref: train_image, preprocessing.py:192-308)
+* eval: central crop of 87.5% then resize (ref: eval_image,
+  preprocessing.py:137-190)
+* cifar10: zero-pad 4px each side, random 32x32 crop, random flip
+  (ref: Cifar10ImagePreprocessor._distort_image, preprocessing.py:656-676);
+  data loaded from the python pickle batches (ref: datasets.py:140-189)
+* sharded readers de-overlap workers by shifting the shard assignment by
+  ``shift_ratio`` (ref: RecordInput shift_ratio, preprocessing.py:601-617)
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import io
+import os
+import pickle
+import random
+import threading
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kf_benchmarks_tpu.data import example as example_lib
+from kf_benchmarks_tpu.data import tfrecord
+
+try:
+  from PIL import Image, ImageEnhance
+  _HAVE_PIL = True
+except ImportError:  # pragma: no cover
+  _HAVE_PIL = False
+
+# (ref: preprocessing.py:75-97 _RESIZE_METHOD_MAP + round_robin)
+_RESIZE_METHODS = ("nearest", "bilinear", "bicubic", "area")
+
+
+def _pil_resize_method(name: str):
+  return {
+      "nearest": Image.NEAREST,
+      "bilinear": Image.BILINEAR,
+      "bicubic": Image.BICUBIC,
+      "area": Image.BOX,
+  }[name]
+
+
+def get_image_resize_method(resize_method: str, batch_position: int = 0):
+  """Round-robin per batch position (ref: preprocessing.py:85-127)."""
+  if resize_method != "round_robin":
+    return _pil_resize_method(resize_method)
+  methods = [_pil_resize_method(m) for m in _RESIZE_METHODS]
+  return methods[batch_position % len(methods)]
+
+
+def normalized_image(images: np.ndarray) -> np.ndarray:
+  """[0, 255] -> [-1, 1] (ref: preprocessing.py:130-133)."""
+  return images.astype(np.float32) * (1.0 / 127.5) - 1.0
+
+
+# -- Example proto parsing (ref: preprocessing.py:27-81) ---------------------
+
+def parse_example_proto(record: bytes):
+  """Returns (image_buffer, label, bbox[N,4] ymin,xmin,ymax,xmax)."""
+  feats = example_lib.parse_example(record)
+  image_buffer = feats["image/encoded"][0]
+  label = int(np.asarray(feats["image/class/label"])[0])
+  def _coords(key):
+    v = feats.get(key)
+    return np.asarray(v, np.float32) if v is not None and len(v) else (
+        np.zeros((0,), np.float32))
+  xmin, ymin = _coords("image/object/bbox/xmin"), _coords(
+      "image/object/bbox/ymin")
+  xmax, ymax = _coords("image/object/bbox/xmax"), _coords(
+      "image/object/bbox/ymax")
+  bbox = np.stack([ymin, xmin, ymax, xmax], axis=-1) if len(xmin) else (
+      np.zeros((0, 4), np.float32))
+  return image_buffer, label, bbox
+
+
+# -- crop sampling (tf.image.sample_distorted_bounding_box semantics) --------
+
+def sample_distorted_bounding_box(
+    rng: random.Random, height: int, width: int, bboxes: np.ndarray,
+    min_object_covered: float = 0.1,
+    aspect_ratio_range: Tuple[float, float] = (0.75, 1.33),
+    area_range: Tuple[float, float] = (0.05, 1.0),
+    max_attempts: int = 100) -> Tuple[int, int, int, int]:
+  """Sample a crop window (y, x, h, w); whole image on failure.
+
+  Numpy re-implementation of the sampling the reference gets from
+  ``tf.image.sample_distorted_bounding_box`` (ref: preprocessing.py:219-247
+  train_image's distorted crop).
+  """
+  img_area = float(height * width)
+  for _ in range(max_attempts):
+    aspect = rng.uniform(*aspect_ratio_range)
+    area = rng.uniform(*area_range) * img_area
+    # h * w = area; w / h = aspect  =>  h = sqrt(area / aspect)
+    h = int(round((area / aspect) ** 0.5))
+    w = int(round(h * aspect))
+    if h <= 0 or w <= 0 or h > height or w > width:
+      continue
+    y = rng.randint(0, height - h)
+    x = rng.randint(0, width - w)
+    if len(bboxes):
+      # min_object_covered: the crop must cover >= the fraction of at
+      # least one object box.
+      covered = False
+      for ymin, xmin, ymax, xmax in bboxes:
+        by0, bx0 = ymin * height, xmin * width
+        by1, bx1 = ymax * height, xmax * width
+        barea = max(by1 - by0, 0.0) * max(bx1 - bx0, 0.0)
+        if barea <= 0:
+          continue
+        iy = max(0.0, min(by1, y + h) - max(by0, y))
+        ix = max(0.0, min(bx1, x + w) - max(bx0, x))
+        if iy * ix >= min_object_covered * barea:
+          covered = True
+          break
+      if not covered:
+        continue
+    return y, x, h, w
+  return 0, 0, height, width
+
+
+# -- color distortion (ref: distort_color, preprocessing.py:268-308) ---------
+
+def distort_color(img: "Image.Image", batch_position: int,
+                  rng: random.Random) -> "Image.Image":
+  """Brightness/saturation/contrast jitter, order by batch position
+  (ref fast-mode orderings; hue omitted as in the reference's fast path)."""
+  def brightness(i):
+    # max_delta = 32/255 in [0,1] space == factor jitter around 1.
+    return ImageEnhance.Brightness(i).enhance(
+        1.0 + rng.uniform(-32.0 / 255.0, 32.0 / 255.0))
+  def saturation(i):
+    return ImageEnhance.Color(i).enhance(rng.uniform(0.5, 1.5))
+  def contrast(i):
+    return ImageEnhance.Contrast(i).enhance(rng.uniform(0.5, 1.5))
+  if batch_position % 2 == 0:
+    ops = (brightness, saturation, contrast)
+  else:
+    ops = (brightness, contrast, saturation)
+  for op in ops:
+    img = op(img)
+  return img
+
+
+def train_image(image_buffer: bytes, height: int, width: int,
+                bbox: np.ndarray, batch_position: int,
+                resize_method: str, distortions: bool,
+                rng: random.Random) -> np.ndarray:
+  """Distorted-crop training path -> float32 [0,255] HWC
+  (ref: train_image, preprocessing.py:192-265)."""
+  img = Image.open(io.BytesIO(image_buffer)).convert("RGB")
+  iw, ih = img.size
+  y, x, h, w = sample_distorted_bounding_box(rng, ih, iw, bbox)
+  # fuse_decode_and_crop analog: crop before the (expensive) resize.
+  img = img.crop((x, y, x + w, y + h))
+  method = get_image_resize_method(resize_method, batch_position)
+  img = img.resize((width, height), method)
+  if rng.random() < 0.5:
+    img = img.transpose(Image.FLIP_LEFT_RIGHT)
+  if distortions:
+    img = distort_color(img, batch_position, rng)
+  return np.asarray(img, dtype=np.float32)
+
+
+def eval_image(image_buffer: bytes, height: int, width: int,
+               batch_position: int, resize_method: str) -> np.ndarray:
+  """Central-crop-87.5% eval path -> float32 [0,255] HWC
+  (ref: eval_image, preprocessing.py:137-190)."""
+  img = Image.open(io.BytesIO(image_buffer)).convert("RGB")
+  iw, ih = img.size
+  ch, cw = int(ih * 0.875), int(iw * 0.875)
+  y, x = (ih - ch) // 2, (iw - cw) // 2
+  img = img.crop((x, y, x + cw, y + ch))
+  method = get_image_resize_method(resize_method, batch_position)
+  img = img.resize((width, height), method)
+  return np.asarray(img, dtype=np.float32)
+
+
+# -- preprocessors -----------------------------------------------------------
+
+class InputPreprocessor:
+  """Base preprocessor (ref: preprocessing.py:311-548). Yields numpy
+  (images[global_batch, H, W, C] float32 normalized, labels[int32])."""
+
+  def __init__(self, batch_size: int, output_shape: Sequence[int],
+               train: bool = True, distortions: bool = False,
+               resize_method: str = "bilinear", seed: int = 301,
+               shift_ratio: float = 0.0, num_threads: int = 8):
+    self.batch_size = batch_size
+    self.height, self.width, self.depth = output_shape
+    self.train = train
+    self.distortions = distortions
+    self.resize_method = resize_method
+    self.seed = seed
+    self.shift_ratio = shift_ratio
+    self.num_threads = max(1, num_threads)
+
+  def minibatches(self, dataset, subset: str) -> Iterator[
+      Tuple[np.ndarray, np.ndarray]]:
+    raise NotImplementedError
+
+  def supports_datasets(self) -> bool:
+    return True
+
+
+class RecordInputImagePreprocessor(InputPreprocessor):
+  """TFRecord image classification pipeline
+  (ref: preprocessing.py:551-632)."""
+
+  def _record_stream(self, dataset, subset: str) -> Iterator[bytes]:
+    shards = tfrecord.list_shards(dataset.data_dir, subset)
+    # shift_ratio de-overlap: rotate the shard order per worker
+    # (ref: RecordInput shift_ratio, preprocessing.py:601-617).
+    shift = int(len(shards) * self.shift_ratio) % max(len(shards), 1)
+    shards = shards[shift:] + shards[:shift]
+    rng = random.Random(self.seed)
+    while True:
+      order = list(shards)
+      if self.train:
+        rng.shuffle(order)
+      for path in order:
+        yield from tfrecord.read_records(path)
+
+  def _preprocess_one(self, record: bytes, batch_position: int,
+                      rng: random.Random) -> Tuple[np.ndarray, int]:
+    image_buffer, label, bbox = parse_example_proto(record)
+    if self.train:
+      img = train_image(image_buffer, self.height, self.width, bbox,
+                        batch_position, self.resize_method,
+                        self.distortions, rng)
+    else:
+      img = eval_image(image_buffer, self.height, self.width,
+                       batch_position, self.resize_method)
+    return normalized_image(img), label
+
+  def minibatches(self, dataset, subset: str):
+    if not _HAVE_PIL:  # pragma: no cover
+      raise NotImplementedError("PIL is required for the real-data pipeline")
+    stream = self._record_stream(dataset, subset)
+    pool = concurrent.futures.ThreadPoolExecutor(self.num_threads)
+    rngs = [random.Random(self.seed + 7919 * i)
+            for i in range(self.batch_size)]
+    try:
+      while True:
+        records = [next(stream) for _ in range(self.batch_size)]
+        futs = [pool.submit(self._preprocess_one, rec, i, rngs[i])
+                for i, rec in enumerate(records)]
+        results = [f.result() for f in futs]
+        images = np.stack([r[0] for r in results])
+        labels = np.asarray([r[1] for r in results], np.int32)
+        yield images, labels
+    finally:
+      pool.shutdown(wait=False)
+
+
+class Cifar10ImagePreprocessor(InputPreprocessor):
+  """In-memory numpy CIFAR-10 pipeline (ref: preprocessing.py:653-741;
+  pickle loading ref: datasets.py:140-189)."""
+
+  def _read_data_files(self, dataset, subset: str) -> Tuple[np.ndarray,
+                                                            np.ndarray]:
+    if subset == "train":
+      names = [f"data_batch_{i}" for i in range(1, 6)]
+    else:
+      names = ["test_batch"]
+    images, labels = [], []
+    base = dataset.data_dir
+    sub = os.path.join(base, "cifar-10-batches-py")
+    if os.path.isdir(sub):
+      base = sub
+    for name in names:
+      with open(os.path.join(base, name), "rb") as f:
+        batch = pickle.load(f, encoding="bytes")
+      images.append(np.asarray(batch[b"data"], np.uint8))
+      labels.append(np.asarray(batch[b"labels"], np.int32))
+    # stored CHW row-major; reshape+transpose to HWC
+    data = np.concatenate(images).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return data, np.concatenate(labels)
+
+  def _distort(self, image: np.ndarray, rng: random.Random) -> np.ndarray:
+    padded = np.zeros((self.height + 8, self.width + 8, self.depth),
+                      image.dtype)
+    padded[4:4 + self.height, 4:4 + self.width] = image
+    y = rng.randint(0, 8)
+    x = rng.randint(0, 8)
+    out = padded[y:y + self.height, x:x + self.width]
+    if rng.random() < 0.5:
+      out = out[:, ::-1]
+    return out
+
+  def minibatches(self, dataset, subset: str):
+    all_images, all_labels = self._read_data_files(dataset, subset)
+    n = len(all_images)
+    rng = random.Random(self.seed)
+    nprng = np.random.RandomState(self.seed)
+    while True:
+      idx = nprng.randint(0, n, size=self.batch_size) if self.train else None
+      if idx is None:
+        # sequential epochs for eval
+        for start in range(0, n - self.batch_size + 1, self.batch_size):
+          sel = np.arange(start, start + self.batch_size)
+          imgs = all_images[sel].astype(np.float32)
+          yield normalized_image(imgs), all_labels[sel].astype(np.int32)
+        continue
+      imgs = all_images[idx]
+      if self.train and self.distortions:
+        imgs = np.stack([self._distort(im, rng) for im in imgs])
+      yield (normalized_image(imgs.astype(np.float32)),
+             all_labels[idx].astype(np.int32))
+
+
+class TestImagePreprocessor(InputPreprocessor):
+  """Injects fake numpy data as "real" input (ref:
+  preprocessing.py:896-975). ``set_fake_data`` then iterate."""
+
+  def __init__(self, *args, **kwargs):
+    super().__init__(*args, **kwargs)
+    self.fake_images: Optional[np.ndarray] = None
+    self.fake_labels: Optional[np.ndarray] = None
+    self.expected_subset: Optional[str] = None
+
+  def set_fake_data(self, images: np.ndarray, labels: np.ndarray) -> None:
+    self.fake_images = np.asarray(images)
+    self.fake_labels = np.asarray(labels)
+
+  def minibatches(self, dataset, subset: str):
+    del dataset
+    if self.expected_subset is not None:
+      assert subset == self.expected_subset, (subset, self.expected_subset)
+    assert self.fake_images is not None, "call set_fake_data first"
+    n = len(self.fake_images)
+    pos = 0
+    while True:
+      sel = [(pos + i) % n for i in range(self.batch_size)]
+      pos = (pos + self.batch_size) % n
+      yield (self.fake_images[sel].astype(np.float32),
+             self.fake_labels[sel].astype(np.int32))
+
+
+_PREPROCESSORS = {
+    "imagenet": RecordInputImagePreprocessor,
+    "cifar10": Cifar10ImagePreprocessor,
+    "test": TestImagePreprocessor,
+}
+
+
+def get_preprocessor(dataset_name: str, kind: str = "default"):
+  """Name -> preprocessor class (ref: datasets.py:208-229 maps)."""
+  if kind == "test":
+    return TestImagePreprocessor
+  if kind != "default":
+    raise ValueError(f"Unknown input preprocessor {kind!r}; "
+                     f"expected 'default' or 'test'")
+  if dataset_name not in _PREPROCESSORS:
+    raise NotImplementedError(
+        f"No input preprocessor for dataset {dataset_name!r}")
+  return _PREPROCESSORS[dataset_name]

@@ -1,0 +1,122 @@
+"""Pure-Python TFRecord file codec.
+
+The reference reads ImageNet as TFRecord shards via tf.data /
+``data_flow_ops.RecordInput`` (ref: scripts/tf_cnn_benchmarks/
+preprocessing.py:601-617, datasets.py:124-137). This image has no
+TensorFlow, so the framework carries its own reader/writer for the TFRecord
+wire format, which is simply a sequence of:
+
+    uint64 length (little-endian)
+    uint32 masked_crc32c(length_bytes)
+    byte   data[length]
+    uint32 masked_crc32c(data)
+
+CRC32C uses the Castagnoli polynomial with TFRecord's mask
+(((crc >> 15) | (crc << 17)) + 0xa282ead8). Verification is optional on
+read (off by default in the hot path; the step loop is device-bound and
+the reference's RecordInput does not re-verify either).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+_CRC_TABLE: Optional[np.ndarray] = None
+_MASK_DELTA = 0xA282EAD8
+
+
+def _crc_table() -> np.ndarray:
+  global _CRC_TABLE
+  if _CRC_TABLE is None:
+    poly = 0x82F63B78  # reversed Castagnoli
+    table = np.empty(256, dtype=np.uint32)
+    for i in range(256):
+      crc = i
+      for _ in range(8):
+        crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+      table[i] = crc
+    _CRC_TABLE = table
+  return _CRC_TABLE
+
+
+def crc32c(data: bytes) -> int:
+  table = _crc_table()
+  crc = np.uint32(0xFFFFFFFF)
+  buf = np.frombuffer(data, dtype=np.uint8)
+  # Table-driven, byte at a time, vectorized over nothing -- fine for the
+  # record sizes involved (headers are 8 bytes; payload CRC is optional).
+  crc_int = int(crc)
+  tab = table
+  for b in buf:
+    crc_int = (crc_int >> 8) ^ int(tab[(crc_int ^ int(b)) & 0xFF])
+  return crc_int ^ 0xFFFFFFFF
+
+
+def masked_crc32c(data: bytes) -> int:
+  crc = crc32c(data)
+  return (((crc >> 15) | (crc << 17)) + _MASK_DELTA) & 0xFFFFFFFF
+
+
+class TFRecordWriter:
+  """Writes TFRecord files (fixture generation; get_tf_record.py analog)."""
+
+  def __init__(self, path: str):
+    self._f = open(path, "wb")
+
+  def write(self, record: bytes) -> None:
+    header = struct.pack("<Q", len(record))
+    self._f.write(header)
+    self._f.write(struct.pack("<I", masked_crc32c(header)))
+    self._f.write(record)
+    self._f.write(struct.pack("<I", masked_crc32c(record)))
+
+  def close(self) -> None:
+    self._f.close()
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()
+
+
+def read_records(path: str, verify: bool = False) -> Iterator[bytes]:
+  """Yield raw record payloads from one TFRecord file."""
+  with open(path, "rb") as f:
+    while True:
+      header = f.read(8)
+      if not header:
+        return
+      if len(header) != 8:
+        raise IOError(f"Truncated TFRecord header in {path}")
+      (length,) = struct.unpack("<Q", header)
+      length_crc_bytes = f.read(4)
+      if len(length_crc_bytes) != 4:
+        raise IOError(f"Truncated TFRecord length CRC in {path}")
+      if verify and masked_crc32c(header) != struct.unpack(
+          "<I", length_crc_bytes)[0]:
+        raise IOError(f"Corrupt TFRecord length CRC in {path}")
+      data = f.read(length)
+      if len(data) != length:
+        raise IOError(f"Truncated TFRecord payload in {path}")
+      data_crc_bytes = f.read(4)
+      if len(data_crc_bytes) != 4:
+        raise IOError(f"Truncated TFRecord payload CRC in {path}")
+      if verify and masked_crc32c(data) != struct.unpack(
+          "<I", data_crc_bytes)[0]:
+        raise IOError(f"Corrupt TFRecord payload CRC in {path}")
+      yield data
+
+
+def list_shards(data_dir: str, subset: str) -> List[str]:
+  """Shard discovery: ``<subset>-*-of-*`` files, the naming the reference's
+  datasets use (ref: datasets.py:131-137 tf_record_pattern)."""
+  prefix = {"train": "train", "validation": "validation"}[subset]
+  names = sorted(n for n in os.listdir(data_dir) if n.startswith(prefix + "-"))
+  if not names:
+    raise ValueError(f"No TFRecord shards matching {prefix}-* in {data_dir}")
+  return [os.path.join(data_dir, n) for n in names]

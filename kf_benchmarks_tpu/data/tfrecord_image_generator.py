@@ -1,0 +1,53 @@
+"""Synthetic colored-square TFRecord fixture generator.
+
+Analog of the reference's test-data generator (ref:
+scripts/tf_cnn_benchmarks/test_data/tfrecord_image_generator.py): writes
+ImageNet-style Example protos (JPEG bytes + label + bbox) whose images are
+solid colored squares, for input-pipeline tests and smoke runs.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import Sequence
+
+import numpy as np
+
+from kf_benchmarks_tpu.data import example as example_lib
+from kf_benchmarks_tpu.data import tfrecord
+
+
+def _jpeg_bytes(rgb, size: int = 64) -> bytes:
+  from PIL import Image
+  arr = np.zeros((size, size, 3), np.uint8)
+  arr[:, :] = rgb
+  buf = io.BytesIO()
+  Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+  return buf.getvalue()
+
+
+def write_color_square_records(
+    data_dir: str, num_train_shards: int = 2, num_validation_shards: int = 1,
+    examples_per_shard: int = 8, num_classes: int = 10,
+    image_size: int = 64) -> None:
+  os.makedirs(data_dir, exist_ok=True)
+  rng = np.random.RandomState(0)
+  for subset, num_shards in (("train", num_train_shards),
+                             ("validation", num_validation_shards)):
+    for shard in range(num_shards):
+      path = os.path.join(
+          data_dir, f"{subset}-{shard:05d}-of-{num_shards:05d}")
+      with tfrecord.TFRecordWriter(path) as w:
+        for i in range(examples_per_shard):
+          label = int(rng.randint(1, num_classes + 1))
+          rgb = tuple(int(c) for c in rng.randint(0, 256, size=3))
+          record = example_lib.encode_example({
+              "image/encoded": _jpeg_bytes(rgb, image_size),
+              "image/class/label": np.array([label], np.int64),
+              "image/object/bbox/xmin": np.array([0.1], np.float32),
+              "image/object/bbox/ymin": np.array([0.1], np.float32),
+              "image/object/bbox/xmax": np.array([0.9], np.float32),
+              "image/object/bbox/ymax": np.array([0.9], np.float32),
+          })
+          w.write(record)

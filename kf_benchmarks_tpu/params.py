@@ -224,6 +224,9 @@ flags.DEFINE_boolean("use_datasets", True,
 flags.DEFINE_enum("resize_method", "bilinear",
                   ("round_robin", "nearest", "bilinear", "bicubic", "area"),
                   "Eval/train resize method (ref :195-198).")
+flags.DEFINE_string("input_preprocessor", "default",
+                    "Name of the input preprocessor to use "
+                    "(ref: benchmark_cnn.py:179-182).")
 flags.DEFINE_boolean("winograd_nonfused", True,
                      "No-op on TPU; kept for CLI parity (ref :3285-3297).")
 flags.DEFINE_boolean("sparse_to_dense_grads", False,
