@@ -1,0 +1,162 @@
+"""Standalone all-reduce microbenchmark CLI.
+
+TPU-native analog of the reference's all-reduce microbenchmark
+(ref: scripts/tf_cnn_benchmarks/all_reduce_benchmark.py:60-180): build
+model-shaped random gradient tensors, chain ``iters_per_step`` all-reduce
+iterations inside ONE compiled SPMD program (data-dependency chaining
+replaces the reference's control-dependency fencing,
+all_reduce_benchmark.py:89-151), run timed steps, and report the average
+time per all-reduce.
+
+Where the reference times ``sess.run`` of a chained graph, we time calls
+of a jitted ``shard_map`` program over the replica mesh; the spec-driven
+algorithm selection (psum / reduce-scatter+all-gather / hierarchical)
+comes from ops/allreduce.py, sharing the reference's spec grammar.
+
+Run: python -m kf_benchmarks_tpu.all_reduce_benchmark --model=resnet50 \
+         --num_batches=10 --all_reduce_spec=psum
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kf_benchmarks_tpu import flags
+from kf_benchmarks_tpu.models import model_config
+from kf_benchmarks_tpu.ops import allreduce
+from kf_benchmarks_tpu.parallel import mesh as mesh_lib
+from kf_benchmarks_tpu.parallel.mesh import REPLICA_AXIS
+from kf_benchmarks_tpu.utils import log as log_util
+
+if "iters_per_step" not in flags.param_specs:
+  flags.DEFINE_integer(
+      "iters_per_step", 5,
+      "Number of chained all-reduce iterations inside one compiled step "
+      "(ref: all_reduce_benchmark.py flag of the same name).")
+
+
+def get_var_shapes(model) -> List[Tuple[int, ...]]:
+  """Return the model's trainable-variable shapes (ref:
+  all_reduce_benchmark.py:60-66 builds the graph just to read var shapes;
+  here we init the flax module and read the param tree)."""
+  module = model.make_module(nclass=1000, phase_train=True,
+                             data_format="NHWC")
+  size = getattr(model, "image_size", 224)
+  images = jnp.zeros((1, size, size, 3), jnp.float32)
+  rng = jax.random.PRNGKey(0)
+  variables = jax.eval_shape(
+      lambda: module.init({"params": rng, "dropout": rng}, images))
+  leaves = jax.tree_util.tree_leaves(variables.get("params", variables))
+  return [tuple(l.shape) for l in leaves]
+
+
+def build_all_reduce_step(shapes: Sequence[Tuple[int, ...]], mesh,
+                          iters_per_step: int, planner=None,
+                          dtype=jnp.float32):
+  """Compile one step: ``iters_per_step`` chained all-reduces of the
+  tensor list (ref: build_all_reduce_iterations,
+  all_reduce_benchmark.py:89-151). Chaining by data dependency: the
+  reduced output of iteration i is the input of iteration i+1, so XLA
+  cannot elide or overlap the iterations away."""
+
+  def body(*tensors):
+    tensors = list(tensors)
+    for i in range(iters_per_step):
+      if planner is not None:
+        tensors = planner.reduce(tensors, REPLICA_AXIS)
+      else:
+        tensors = [lax.pmean(t, REPLICA_AXIS) for t in tensors]
+      # Perturb between iterations so successive reductions are not
+      # fixpoints (pmean of an already-averaged value); mirrors the
+      # reference reusing live gradient values per iteration.
+      if i + 1 < iters_per_step:
+        tensors = [t + jnp.asarray(1e-6, t.dtype) for t in tensors]
+    return tuple(tensors)
+
+  specs = tuple(P(REPLICA_AXIS) for _ in shapes)
+  fn = jax.shard_map(body, mesh=mesh, in_specs=specs, out_specs=specs)
+  jitted = jax.jit(lambda tensors: fn(*tensors))
+  return jitted
+
+
+def run_benchmark(params) -> Dict[str, float]:
+  """Build + time the all-reduce program; returns timing stats
+  (ref: all_reduce_benchmark.py:155-180 run_benchmark)."""
+  model = model_config.get_model_config(params.model, params.data_name)
+  shapes = get_var_shapes(model)
+  devices = mesh_lib.get_devices(params.device, params.num_devices or None)
+  mesh = mesh_lib.build_mesh(devices=devices)
+  n = mesh.devices.size
+  planner = allreduce.build_planner(params)
+  iters = getattr(params, "iters_per_step", 5)
+  dtype = jnp.bfloat16 if params.use_fp16 else jnp.float32
+
+  step = build_all_reduce_step(shapes, mesh, iters, planner, dtype)
+
+  rng = np.random.RandomState(0)
+  sharding = NamedSharding(mesh, P(REPLICA_AXIS))
+  tensors = [
+      jax.device_put(
+          rng.normal(size=(n,) + s).astype(dtype), sharding)
+      for s in shapes]
+
+  num_bytes = sum(int(np.prod(s)) for s in shapes) * jnp.dtype(dtype).itemsize
+  log_util.log_fn(
+      f"All-reduce benchmark: {len(shapes)} tensors, "
+      f"{num_bytes / 1e6:.2f} MB/replica, {n} replicas, "
+      f"{iters} iters/step")
+
+  num_steps = params.num_batches or 10
+  warmup = params.num_warmup_batches
+  if warmup is None:
+    warmup = 2
+  for _ in range(max(warmup, 1)):  # includes compile
+    out = step(tensors)
+  jax.block_until_ready(out)
+
+  start = time.monotonic()
+  for _ in range(num_steps):
+    out = step(tensors)
+  jax.block_until_ready(out)
+  elapsed = time.monotonic() - start
+
+  avg_step = elapsed / num_steps
+  avg_all_reduce = avg_step / iters
+  log_util.log_fn(f"Average time per step: {avg_step:.6f} sec")
+  log_util.log_fn(f"Average all-reduce time: {avg_all_reduce:.6f} sec")
+  return {
+      "average_time_per_step": avg_step,
+      "average_all_reduce_time": avg_all_reduce,
+      "num_tensors": len(shapes),
+      "bytes_per_replica": num_bytes,
+  }
+
+
+def main(positional_arguments):
+  from absl import app
+  from kf_benchmarks_tpu import params as params_lib
+  if len(positional_arguments) > 1:
+    raise app.UsageError(
+        "Received unknown positional arguments: %s" % positional_arguments[1:])
+  from kf_benchmarks_tpu import benchmark
+  params = params_lib.make_params_from_flags()
+  params = benchmark.setup(params)
+  run_benchmark(params)
+
+
+def run_main():
+  from absl import app
+  from kf_benchmarks_tpu import params as params_lib
+  flags.define_flags(aliases=params_lib.ALIASES)
+  app.run(main)
+
+
+if __name__ == "__main__":
+  run_main()

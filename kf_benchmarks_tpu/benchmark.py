@@ -357,11 +357,11 @@ class BenchmarkCNN:
     num_eval = p.num_eval_batches or self.num_batches
     top1_sum = top5_sum = 0.0
     start = time.time()
-    for _ in range(num_eval):
+    for i in range(num_eval):
       acc = eval_step(state, images, labels)
       top1_sum += float(acc["top_1_accuracy"])
       top5_sum += float(acc["top_5_accuracy"])
-      if next_batch is not None:
+      if next_batch is not None and i + 1 < num_eval:
         images, labels = next_batch()
     elapsed = time.time() - start
     top1, top5 = top1_sum / num_eval, top5_sum / num_eval
@@ -385,26 +385,28 @@ class BenchmarkCNN:
     init_state, train_step, eval_step, broadcast_init = self._build()
     rng = jax.random.PRNGKey(p.tf_random_seed or 0)
     data_rng, init_rng = jax.random.split(rng)
-    next_batch, stop_input = self._input_iterator(data_rng, "validation")
-    images, labels = next_batch()
+    shape = self._model_image_shape()
     state = jax.jit(init_state)(
-        init_rng, jnp.zeros((self.batch_size_per_device,) +
-                            tuple(images.shape[1:]), images.dtype))
-    real_data = not self.dataset.use_synthetic_gpu_inputs()
-    eval_feed = next_batch if real_data else None
+        init_rng, jnp.zeros((self.batch_size_per_device,) + shape,
+                            jnp.float32))
     if not p.train_dir:
-      try:
-        return self._eval_once(state, eval_step, images, labels, eval_feed)
-      finally:
-        stop_input()
+      return self._eval_pass(state, eval_step, data_rng)
+    return self._eval_poll_loop(state, eval_step, data_rng)
 
+  def _eval_pass(self, state, eval_step, data_rng) -> Dict[str, Any]:
+    """One full eval over a FRESH validation stream, so every checkpoint
+    is scored on the same data (the reference re-runs its input pipeline
+    per eval, ref: benchmark_cnn.py:1829-1862 _initialize_eval_graph)."""
+    next_batch, stop_input = self._input_iterator(data_rng, "validation")
     try:
-      return self._eval_poll_loop(
-          state, eval_step, images, labels, eval_feed)
+      images, labels = next_batch()
+      real_data = not self.dataset.use_synthetic_gpu_inputs()
+      return self._eval_once(state, eval_step, images, labels,
+                             next_batch if real_data else None)
     finally:
       stop_input()
 
-  def _eval_poll_loop(self, state, eval_step, images, labels, eval_feed):
+  def _eval_poll_loop(self, state, eval_step, data_rng):
     p = self.params
     last_evaluated_step = -1
     results = None
@@ -437,8 +439,7 @@ class BenchmarkCNN:
           continue
         state = checkpoint.restore_state(state, snapshot)
         log_fn(f"Evaluating checkpoint at global step {ckpt_step}")
-        results = self._eval_once(state, eval_step, images, labels,
-                                  eval_feed)
+        results = self._eval_pass(state, eval_step, data_rng)
         results["global_step"] = ckpt_step
         last_evaluated_step = ckpt_step
         stale_polls = 0

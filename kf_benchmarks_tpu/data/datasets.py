@@ -54,7 +54,10 @@ class ImagenetDataset(Dataset):
   """(ref: datasets.py:124-137)"""
 
   def __init__(self, data_dir=None):
-    super().__init__("imagenet", data_dir, num_classes=1000)
+    # 1001 classes: TFRecord labels are 1-based with 0 reserved for
+    # background, and flow to the logits unshifted (ref: datasets.py:116,
+    # preprocessing.py:57 keeps the raw label).
+    super().__init__("imagenet", data_dir, num_classes=1001)
 
   def num_examples_per_epoch(self, subset="train"):
     if subset == "train":

@@ -34,9 +34,15 @@ class DeviceFeeder:
 
   def _worker(self) -> None:
     try:
-      for batch in self._host_iterator:
-        if self._stop.is_set():
-          return
+      it = iter(self._host_iterator)
+      # Check the stop flag BEFORE pulling: pulling is where the host
+      # preprocessing work happens, so a stopped feeder must not decode
+      # another full global batch just to discard it.
+      while not self._stop.is_set():
+        try:
+          batch = next(it)
+        except StopIteration:
+          break
         device_batch = jax.tree.map(
             lambda x: jax.device_put(x, self._sharding), batch)
         while not self._stop.is_set():
@@ -45,7 +51,8 @@ class DeviceFeeder:
             break
           except queue.Full:
             continue
-      self._queue.put(None)
+      if not self._stop.is_set():
+        self._queue.put(None)
     except BaseException as e:  # surfaced on the consumer side
       self._error = e
 
@@ -72,9 +79,17 @@ class DeviceFeeder:
 
   def stop(self) -> None:
     self._stop.set()
-    # Drain so the worker unblocks.
-    try:
-      while True:
-        self._queue.get_nowait()
-    except queue.Empty:
-      pass
+    # Drain so the worker unblocks, then join it and close the host
+    # iterator so generator cleanup (e.g. the preprocessor's thread pool
+    # shutdown in its finally block) runs deterministically rather than
+    # at GC time.
+    while self._thread.is_alive():
+      try:
+        while True:
+          self._queue.get_nowait()
+      except queue.Empty:
+        pass
+      self._thread.join(timeout=0.1)
+    close = getattr(self._host_iterator, "close", None)
+    if close is not None:
+      close()

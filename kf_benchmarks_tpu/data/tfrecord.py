@@ -45,14 +45,12 @@ def _crc_table() -> np.ndarray:
 
 def crc32c(data: bytes) -> int:
   table = _crc_table()
-  crc = np.uint32(0xFFFFFFFF)
   buf = np.frombuffer(data, dtype=np.uint8)
   # Table-driven, byte at a time, vectorized over nothing -- fine for the
   # record sizes involved (headers are 8 bytes; payload CRC is optional).
-  crc_int = int(crc)
-  tab = table
+  crc_int = 0xFFFFFFFF
   for b in buf:
-    crc_int = (crc_int >> 8) ^ int(tab[(crc_int ^ int(b)) & 0xFF])
+    crc_int = (crc_int >> 8) ^ int(table[(crc_int ^ int(b)) & 0xFF])
   return crc_int ^ 0xFFFFFFFF
 
 

@@ -251,6 +251,16 @@ def _rebuild_params_type():
   Params = collections.namedtuple("Params", list(flags.param_specs.keys()))
 
 
+def _params_type():
+  """Rebuild Params when late DEFINEs grew the registry (the platform-hook
+  / aux-CLI extension point: modules like all_reduce_benchmark register
+  extra params at import, the analog of define_platform_params,
+  ref: platforms/default/util.py:28-33)."""
+  if Params is None or Params._fields != tuple(flags.param_specs.keys()):
+    _rebuild_params_type()
+  return Params
+
+
 _rebuild_params_type()
 
 
@@ -271,7 +281,7 @@ def make_params(**kwargs) -> "Params":
   defaults = {name: spec.default_value
               for name, spec in flags.param_specs.items()}
   defaults.update(translated)
-  params = Params(**defaults)
+  params = _params_type()(**defaults)
   validate_params(params)
   return params
 
@@ -279,9 +289,10 @@ def make_params(**kwargs) -> "Params":
 def make_params_from_flags() -> "Params":
   """Construct Params from parsed absl FLAGS (ref: benchmark_cnn.py:1013)."""
   values = flags.flag_values_as_dict()
-  params = Params(**{k: flags.canonicalize_value(flags.param_specs[k], v)
-                     if v is not None else None
-                     for k, v in values.items()})
+  params = _params_type()(
+      **{k: flags.canonicalize_value(flags.param_specs[k], v)
+         if v is not None else None
+         for k, v in values.items()})
   validate_params(params)
   return params
 

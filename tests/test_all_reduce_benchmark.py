@@ -1,0 +1,50 @@
+"""Smoke tests for the all-reduce microbenchmark CLI
+(ref: all_reduce_benchmark_test.py:28-51 -- 2-GPU-shape CPU-run smoke)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kf_benchmarks_tpu import all_reduce_benchmark as arb
+from kf_benchmarks_tpu import params as params_lib
+from kf_benchmarks_tpu.parallel import mesh as mesh_lib
+
+
+def test_get_var_shapes_trivial():
+  from kf_benchmarks_tpu.models import model_config
+  model = model_config.get_model_config("trivial", "imagenet")
+  shapes = arb.get_var_shapes(model)
+  assert shapes, "expected at least one trainable variable"
+  assert all(isinstance(s, tuple) for s in shapes)
+
+
+def test_chained_step_numerics():
+  """A chained step over identical per-replica values must keep the mean
+  (up to the inter-iteration perturbation)."""
+  mesh = mesh_lib.build_mesh(num_devices=4, device_kind="cpu")
+  step = arb.build_all_reduce_step([(3,), (2, 2)], mesh, iters_per_step=2)
+  n = 4
+  t0 = np.stack([np.full((3,), float(i)) for i in range(n)]).astype(np.float32)
+  t1 = np.stack([np.full((2, 2), float(2 * i)) for i in range(n)]) \
+      .astype(np.float32)
+  out = step([jnp.asarray(t0), jnp.asarray(t1)])
+  # After one pmean the value is mean(i)=1.5; the perturbation adds 1e-6;
+  # the second pmean keeps it. Every replica row must agree.
+  expected0 = np.full((n, 3), 1.5 + 1e-6, np.float32)
+  expected1 = np.full((n, 2, 2), 3.0 + 1e-6, np.float32)
+  np.testing.assert_allclose(np.asarray(out[0]), expected0, rtol=1e-6)
+  np.testing.assert_allclose(np.asarray(out[1]), expected1, rtol=1e-6)
+
+
+@pytest.mark.parametrize("spec", [None, "psum", "psum:32k:rsag",
+                                  "pscpu:32k:xring"])
+def test_run_benchmark_smoke(spec):
+  params = params_lib.make_params(
+      model="trivial", num_batches=2, num_warmup_batches=1,
+      device="cpu", num_devices=4, all_reduce_spec=spec,
+      iters_per_step=2)
+  stats = arb.run_benchmark(params)
+  assert stats["average_time_per_step"] > 0
+  assert stats["average_all_reduce_time"] > 0
+  assert stats["num_tensors"] >= 1
