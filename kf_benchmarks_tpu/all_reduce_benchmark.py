@@ -42,11 +42,11 @@ if "iters_per_step" not in flags.param_specs:
       "(ref: all_reduce_benchmark.py flag of the same name).")
 
 
-def get_var_shapes(model) -> List[Tuple[int, ...]]:
+def get_var_shapes(model, nclass: int = 1001) -> List[Tuple[int, ...]]:
   """Return the model's trainable-variable shapes (ref:
   all_reduce_benchmark.py:60-66 builds the graph just to read var shapes;
   here we init the flax module and read the param tree)."""
-  module = model.make_module(nclass=1000, phase_train=True,
+  module = model.make_module(nclass=nclass, phase_train=True,
                              data_format="NHWC")
   size = getattr(model, "image_size", 224)
   images = jnp.zeros((1, size, size, 3), jnp.float32)
@@ -58,8 +58,7 @@ def get_var_shapes(model) -> List[Tuple[int, ...]]:
 
 
 def build_all_reduce_step(shapes: Sequence[Tuple[int, ...]], mesh,
-                          iters_per_step: int, planner=None,
-                          dtype=jnp.float32):
+                          iters_per_step: int, planner=None):
   """Compile one step: ``iters_per_step`` chained all-reduces of the
   tensor list (ref: build_all_reduce_iterations,
   all_reduce_benchmark.py:89-151). Chaining by data dependency: the
@@ -89,8 +88,10 @@ def build_all_reduce_step(shapes: Sequence[Tuple[int, ...]], mesh,
 def run_benchmark(params) -> Dict[str, float]:
   """Build + time the all-reduce program; returns timing stats
   (ref: all_reduce_benchmark.py:155-180 run_benchmark)."""
+  from kf_benchmarks_tpu.data import datasets
   model = model_config.get_model_config(params.model, params.data_name)
-  shapes = get_var_shapes(model)
+  dataset = datasets.create_dataset(None, params.data_name)
+  shapes = get_var_shapes(model, nclass=dataset.num_classes)
   devices = mesh_lib.get_devices(params.device, params.num_devices or None)
   mesh = mesh_lib.build_mesh(devices=devices)
   n = mesh.devices.size
@@ -98,7 +99,7 @@ def run_benchmark(params) -> Dict[str, float]:
   iters = getattr(params, "iters_per_step", 5)
   dtype = jnp.bfloat16 if params.use_fp16 else jnp.float32
 
-  step = build_all_reduce_step(shapes, mesh, iters, planner, dtype)
+  step = build_all_reduce_step(shapes, mesh, iters, planner)
 
   rng = np.random.RandomState(0)
   sharding = NamedSharding(mesh, P(REPLICA_AXIS))

@@ -1,0 +1,164 @@
+"""MobileNet v2, TPU-native flax implementation.
+
+Capability parity with the reference's slim op-spec MobileNet stack
+(ref: scripts/tf_cnn_benchmarks/models/mobilenet.py op-spec interpreter,
+models/conv_blocks.py expanded_conv, models/mobilenet_v2.py:42-78 V2_DEF
++ :188-198 MobilenetModel). The reference drives a generic slim
+``arg_scope`` interpreter over an op list; here the same architecture
+table (`V2_DEF`) is interpreted directly into flax submodules inside one
+compact module, so XLA sees a single fusable graph. Inverted-residual
+blocks keep depthwise convs in NHWC, the layout the TPU vector unit
+wants.
+
+Sandler et al., "MobileNetV2: Inverted Residuals and Linear Bottlenecks"
+(arXiv:1801.04381).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from kf_benchmarks_tpu.models import model as model_lib
+
+
+def make_divisible(v: float, divisor: int = 8,
+                   min_value: Optional[int] = None) -> int:
+  """Round channel counts to a multiple of ``divisor`` without dropping
+  more than 10% (ref: mobilenet.py _make_divisible)."""
+  if min_value is None:
+    min_value = divisor
+  new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+  if new_v < 0.9 * v:
+    new_v += divisor
+  return new_v
+
+
+@dataclasses.dataclass(frozen=True)
+class OpSpec:
+  """One row of the architecture table (ref: mobilenet_v2.py:42-78 ``op``
+  entries): 'conv' is a full conv, 'expanded_conv' an inverted-residual
+  bottleneck with the given expansion factor."""
+  op: str
+  num_outputs: int
+  stride: int = 1
+  expansion: int = 6
+  kernel: int = 3
+
+
+# ref: mobilenet_v2.py:56-79 V2_DEF['spec']
+V2_DEF: Tuple[OpSpec, ...] = (
+    OpSpec("conv", 32, stride=2),
+    OpSpec("expanded_conv", 16, expansion=1),
+    OpSpec("expanded_conv", 24, stride=2),
+    OpSpec("expanded_conv", 24),
+    OpSpec("expanded_conv", 32, stride=2),
+    OpSpec("expanded_conv", 32),
+    OpSpec("expanded_conv", 32),
+    OpSpec("expanded_conv", 64, stride=2),
+    OpSpec("expanded_conv", 64),
+    OpSpec("expanded_conv", 64),
+    OpSpec("expanded_conv", 64),
+    OpSpec("expanded_conv", 96),
+    OpSpec("expanded_conv", 96),
+    OpSpec("expanded_conv", 96),
+    OpSpec("expanded_conv", 160, stride=2),
+    OpSpec("expanded_conv", 160),
+    OpSpec("expanded_conv", 160),
+    OpSpec("expanded_conv", 320),
+    OpSpec("conv", 1280, kernel=1),
+)
+
+
+class MobilenetV2Module(nn.Module):
+  """Interprets V2_DEF into an inverted-residual network + classifier."""
+
+  nclass: int
+  phase_train: bool
+  depth_multiplier: float = 1.0
+  dropout_keep_prob: float = 0.8
+  dtype: Any = jnp.float32
+  param_dtype: Any = jnp.float32
+
+  def _bn(self, x):
+    # slim defaults the reference trains with: decay 0.997, eps 0.001
+    # (ref: mobilenet.py training_scope).
+    return nn.BatchNorm(
+        use_running_average=not self.phase_train, momentum=0.997,
+        epsilon=1e-3, dtype=self.dtype, param_dtype=self.param_dtype)(x)
+
+  def _conv(self, x, features, kernel, stride, groups=1):
+    return nn.Conv(
+        features, (kernel, kernel), strides=(stride, stride),
+        padding="SAME", use_bias=False, feature_group_count=groups,
+        dtype=self.dtype, param_dtype=self.param_dtype)(x)
+
+  def _depth(self, channels: int) -> int:
+    return make_divisible(channels * self.depth_multiplier)
+
+  @nn.compact
+  def __call__(self, images):
+    x = images.astype(self.dtype)
+    for i, spec in enumerate(V2_DEF):
+      if spec.op == "conv":
+        out = self._depth(spec.num_outputs)
+        x = self._conv(x, out, spec.kernel, spec.stride)
+        x = self._bn(x)
+        x = nn.relu6(x)
+      else:
+        inp = x.shape[-1]
+        out = self._depth(spec.num_outputs)
+        h = x
+        expanded = inp * spec.expansion
+        if spec.expansion != 1:
+          h = self._conv(h, expanded, 1, 1)
+          h = self._bn(h)
+          h = nn.relu6(h)
+        # Depthwise 3x3 (feature_group_count == channels).
+        h = self._conv(h, expanded, spec.kernel, spec.stride,
+                       groups=expanded)
+        h = self._bn(h)
+        h = nn.relu6(h)
+        # Linear bottleneck projection: no activation (ref:
+        # conv_blocks.py expanded_conv projection).
+        h = self._conv(h, out, 1, 1)
+        h = self._bn(h)
+        if spec.stride == 1 and out == inp:
+          h = h + x
+        x = h
+    # Global pool + dropout + 1x1-conv classifier
+    # (ref: mobilenet.py mobilenet() top).
+    x = jnp.mean(x, axis=(1, 2))
+    if self.phase_train and self.dropout_keep_prob < 1.0:
+      x = nn.Dropout(rate=1.0 - self.dropout_keep_prob,
+                     deterministic=False)(x)
+    logits = nn.Dense(self.nclass, dtype=self.dtype,
+                      param_dtype=self.param_dtype)(x)
+    return logits.astype(jnp.float32), None
+
+
+class MobilenetModel(model_lib.CNNModel):
+  """Mobilenet model configuration (ref: mobilenet_v2.py:188-198)."""
+
+  def __init__(self, params=None, depth_multiplier: float = 1.0):
+    super().__init__("mobilenet", 224, 32, 0.005, params=params)
+    self.depth_multiplier = depth_multiplier
+
+  def skip_final_affine_layer(self):
+    return True
+
+  def make_module(self, nclass, phase_train, data_format="NHWC",
+                  dtype=jnp.float32, param_dtype=jnp.float32):
+    del data_format  # NHWC throughout; NCHW inputs not supported here
+    return MobilenetV2Module(
+        nclass=nclass, phase_train=phase_train,
+        depth_multiplier=self.depth_multiplier,
+        dtype=dtype, param_dtype=param_dtype)
+
+
+def create_mobilenet_model(params=None):
+  return MobilenetModel(params=params)

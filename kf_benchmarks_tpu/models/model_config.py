@@ -16,6 +16,8 @@ from kf_benchmarks_tpu.models import densenet_model
 from kf_benchmarks_tpu.models import googlenet_model
 from kf_benchmarks_tpu.models import inception_model
 from kf_benchmarks_tpu.models import lenet_model
+from kf_benchmarks_tpu.models import mobilenet_v2
+from kf_benchmarks_tpu.models import nasnet_model
 from kf_benchmarks_tpu.models import overfeat_model
 from kf_benchmarks_tpu.models import resnet_model
 from kf_benchmarks_tpu.models import trivial_model
@@ -32,6 +34,9 @@ _model_name_to_imagenet_model: Dict[str, Callable] = {
     "trivial": trivial_model.TrivialModel,
     "inception3": inception_model.Inceptionv3Model,
     "inception4": inception_model.Inceptionv4Model,
+    "mobilenet": mobilenet_v2.create_mobilenet_model,
+    "nasnet": nasnet_model.create_nasnet_model,
+    "nasnetlarge": nasnet_model.create_nasnetlarge_model,
     "resnet50": resnet_model.create_resnet50_model,
     "resnet50_v1.5": resnet_model.create_resnet50_v15_model,
     "resnet50_v2": resnet_model.create_resnet50_v2_model,
@@ -47,6 +52,7 @@ _model_name_to_cifar_model: Dict[str, Callable] = {
     "densenet40_k12": densenet_model.create_densenet40_k12_model,
     "densenet100_k12": densenet_model.create_densenet100_k12_model,
     "densenet100_k24": densenet_model.create_densenet100_k24_model,
+    "nasnet": nasnet_model.create_nasnet_cifar_model,
     "resnet20": resnet_model.create_resnet20_cifar_model,
     "resnet20_v2": resnet_model.create_resnet20_v2_cifar_model,
     "resnet32": resnet_model.create_resnet32_cifar_model,

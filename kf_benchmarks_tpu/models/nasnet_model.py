@@ -1,0 +1,365 @@
+"""NASNet-A (mobile / large / cifar), TPU-native flax implementation.
+
+Capability parity with the reference's slim NASNet stack (ref:
+scripts/tf_cnn_benchmarks/models/nasnet_model.py:535-578 model classes,
+:440-533 _build_nasnet_base, :248-291 _imagenet_stem/_cifar_stem,
+models/nasnet_utils.py:241-491 NasNetABaseCell/NormalCell/ReductionCell).
+The cell algorithm (op tables, hidden-state indices, unused-state
+concatenation, factorized reduction) is re-expressed as one compact flax
+module; separable convs lower to depthwise+pointwise pairs that XLA
+fuses, and all shapes are static so the whole network tiles onto the MXU.
+
+Simplification vs reference: drop-path keep-prob uses the cell-depth
+schedule but not the global-step ramp (the reference divides by
+total_training_steps, nasnet_utils.py:407-439); benchmark runs are far
+shorter than a convergence run, where the ramp is ~1 anyway.
+
+Zoph et al., "Learning Transferable Architectures for Scalable Image
+Recognition" (arXiv:1707.07012).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from kf_benchmarks_tpu.models import model as model_lib
+
+# NASNet-A cell op tables (ref: nasnet_utils.py:465-491).
+NORMAL_OPERATIONS = (
+    "separable_5x5_2", "separable_3x3_2", "separable_5x5_2",
+    "separable_3x3_2", "avg_pool_3x3", "none", "avg_pool_3x3",
+    "avg_pool_3x3", "separable_3x3_2", "none")
+NORMAL_USED_HIDDENSTATES = (1, 0, 0, 0, 0, 0, 0)
+NORMAL_HIDDENSTATE_INDICES = (0, 1, 1, 1, 0, 1, 1, 1, 0, 0)
+
+REDUCTION_OPERATIONS = (
+    "separable_5x5_2", "separable_7x7_2", "max_pool_3x3", "separable_7x7_2",
+    "avg_pool_3x3", "separable_5x5_2", "none", "avg_pool_3x3",
+    "separable_3x3_2", "max_pool_3x3")
+REDUCTION_USED_HIDDENSTATES = (1, 1, 1, 0, 0, 0, 0)
+REDUCTION_HIDDENSTATE_INDICES = (0, 1, 0, 1, 0, 1, 3, 2, 2, 0)
+
+
+def calc_reduction_layers(num_cells: int,
+                          num_reduction_layers: int) -> List[int]:
+  """Cell indices where reduction cells go (ref: nasnet_utils.py:44-51)."""
+  return [int(float(pool_num) / (num_reduction_layers + 1) * num_cells)
+          for pool_num in range(1, num_reduction_layers + 1)]
+
+
+def _op_info(operation: str) -> Tuple[int, int]:
+  """'separable_5x5_2' -> (kernel=5, num_layers=2)
+  (ref: nasnet_utils.py _operation_to_info)."""
+  parts = operation.split("_")
+  return int(parts[1].split("x")[0]), int(parts[2])
+
+
+class NasnetModule(nn.Module):
+  """NASNet-A network as a single compact module."""
+
+  nclass: int
+  phase_train: bool
+  num_cells: int
+  num_conv_filters: int
+  stem_multiplier: float
+  stem_type: str  # 'imagenet' | 'cifar'
+  dense_dropout_keep_prob: float = 0.5
+  drop_path_keep_prob: float = 1.0
+  filter_scaling_rate: float = 2.0
+  num_reduction_layers: int = 2
+  skip_reduction_layer_input: bool = False
+  use_aux_head: bool = True
+  dtype: Any = jnp.float32
+  param_dtype: Any = jnp.float32
+
+  # -- primitive layers -----------------------------------------------------
+
+  def _bn(self, x):
+    # slim nasnet arg_scope: decay 0.9997, eps 0.001.
+    return nn.BatchNorm(use_running_average=not self.phase_train,
+                        momentum=0.9997, epsilon=1e-3, dtype=self.dtype,
+                        param_dtype=self.param_dtype)(x)
+
+  def _conv(self, x, features, kernel, stride=1, padding="SAME"):
+    return nn.Conv(features, (kernel, kernel), strides=(stride, stride),
+                   padding=padding, use_bias=False, dtype=self.dtype,
+                   param_dtype=self.param_dtype)(x)
+
+  def _sep_conv_layer(self, x, features, kernel, stride):
+    """Depthwise then pointwise (slim.separable_conv2d depth_multiplier=1)."""
+    in_ch = x.shape[-1]
+    x = nn.Conv(in_ch, (kernel, kernel), strides=(stride, stride),
+                padding="SAME", feature_group_count=in_ch, use_bias=False,
+                dtype=self.dtype, param_dtype=self.param_dtype)(x)
+    return nn.Conv(features, (1, 1), use_bias=False, dtype=self.dtype,
+                   param_dtype=self.param_dtype)(x)
+
+  def _stacked_separable_conv(self, x, operation, filter_size, stride):
+    """relu->sep->bn, twice; stride only on the first
+    (ref: nasnet_utils.py:172-201)."""
+    kernel, num_layers = _op_info(operation)
+    for _ in range(num_layers):
+      x = nn.relu(x)
+      x = self._sep_conv_layer(x, filter_size, kernel, stride)
+      x = self._bn(x)
+      stride = 1
+    return x
+
+  def _pooling(self, x, operation, stride):
+    window, strides = (3, 3), (stride, stride)
+    if operation.startswith("avg"):
+      return nn.avg_pool(x, window, strides, "SAME",
+                         count_include_pad=False)
+    return nn.max_pool(x, window, strides, "SAME")
+
+  def _factorized_reduction(self, x, output_filters, stride):
+    """Stride-2 reduction without information loss
+    (ref: nasnet_utils.py:84-131)."""
+    if stride == 1:
+      x = self._conv(x, output_filters, 1)
+      return self._bn(x)
+    strides = (stride, stride)
+    # 1x1-window strided pool == grid subsampling (ref uses avg_pool).
+    path1 = nn.max_pool(x, (1, 1), strides, "VALID")
+    path1 = self._conv(path1, output_filters // 2, 1)
+    # Shift by one pixel so the second path samples the complementary grid.
+    path2 = jnp.pad(x, ((0, 0), (0, 1), (0, 1), (0, 0)))[:, 1:, 1:, :]
+    path2 = nn.max_pool(path2, (1, 1), strides, "VALID")
+    path2 = self._conv(path2, output_filters - output_filters // 2, 1)
+    return self._bn(jnp.concatenate([path1, path2], axis=-1))
+
+  def _drop_path(self, x, cell_num, total_cells):
+    """Whole-example drop with cell-depth-scaled keep prob
+    (ref: nasnet_utils.py:134-145 drop_path, :406-439 schedule)."""
+    keep_prob = self.drop_path_keep_prob
+    if not self.phase_train or keep_prob >= 1.0 or cell_num < 0:
+      return x
+    layer_ratio = (cell_num + 1) / float(total_cells)
+    keep_prob = 1.0 - layer_ratio * (1.0 - keep_prob)
+    rng = self.make_rng("dropout")
+    noise = keep_prob + jax.random.uniform(
+        rng, (x.shape[0], 1, 1, 1), x.dtype)
+    return x / jnp.asarray(keep_prob, x.dtype) * jnp.floor(noise)
+
+  # -- cell -----------------------------------------------------------------
+
+  def _reduce_prev_layer(self, prev, curr, filter_size):
+    """Match prev cell output to curr's spatial/channel dims
+    (ref: nasnet_utils.py:265-282)."""
+    if prev is None:
+      return curr
+    if prev.shape[2] != curr.shape[2]:
+      prev = nn.relu(prev)
+      prev = self._factorized_reduction(prev, filter_size, 2)
+    elif prev.shape[-1] != filter_size:
+      prev = nn.relu(prev)
+      prev = self._conv(prev, filter_size, 1)
+      prev = self._bn(prev)
+    return prev
+
+  def _apply_op(self, x, operation, stride, is_from_original_input,
+                filter_size, cell_num, total_cells):
+    """(ref: nasnet_utils.py:350-377)."""
+    if stride > 1 and not is_from_original_input:
+      stride = 1
+    input_filters = x.shape[-1]
+    if "separable" in operation:
+      x = self._stacked_separable_conv(x, operation, filter_size, stride)
+    elif operation == "none":
+      if stride > 1 or input_filters != filter_size:
+        x = nn.relu(x)
+        x = self._conv(x, filter_size, 1, stride)
+        x = self._bn(x)
+    elif "pool" in operation:
+      x = self._pooling(x, operation, stride)
+      if input_filters != filter_size:
+        x = self._conv(x, filter_size, 1)
+        x = self._bn(x)
+    else:
+      raise ValueError(f"Unimplemented operation {operation}")
+    if operation != "none":
+      x = self._drop_path(x, cell_num, total_cells)
+    return x
+
+  def _cell(self, x, prev, operations, used_hiddenstates,
+            hiddenstate_indices, filter_size, stride, cell_num, total_cells):
+    """One NASNet-A cell (ref: nasnet_utils.py:284-348)."""
+    prev = self._reduce_prev_layer(prev, x, filter_size)
+    h = nn.relu(x)
+    h = self._conv(h, filter_size, 1)
+    h = self._bn(h)
+    states = [h, prev]
+    for it in range(5):
+      li, ri = hiddenstate_indices[2 * it], hiddenstate_indices[2 * it + 1]
+      h1 = self._apply_op(states[li], operations[2 * it], stride, li < 2,
+                          filter_size, cell_num, total_cells)
+      h2 = self._apply_op(states[ri], operations[2 * it + 1], stride, ri < 2,
+                          filter_size, cell_num, total_cells)
+      states.append(h1 + h2)
+    # Concat states not consumed by any combination
+    # (ref: nasnet_utils.py:377-405).
+    final_h, final_f = states[-1].shape[2], states[-1].shape[-1]
+    outs = []
+    for idx, used in enumerate(used_hiddenstates):
+      if used:
+        continue
+      s = states[idx]
+      if s.shape[2] != final_h or s.shape[-1] != final_f:
+        s = self._factorized_reduction(
+            s, final_f, 2 if s.shape[2] != final_h else 1)
+      outs.append(s)
+    return jnp.concatenate(outs, axis=-1)
+
+  def _aux_head(self, x):
+    """Auxiliary classifier (ref: nasnet_model.py:222-246)."""
+    x = nn.relu(x)
+    x = nn.avg_pool(x, (5, 5), (3, 3), "VALID")
+    x = self._conv(x, 128, 1)
+    x = self._bn(x)
+    x = nn.relu(x)
+    x = self._conv(x, 768, x.shape[1], padding="VALID")
+    x = self._bn(x)
+    x = nn.relu(x)
+    x = x.reshape((x.shape[0], -1))
+    return nn.Dense(self.nclass, dtype=self.dtype,
+                    param_dtype=self.param_dtype)(x)
+
+  # -- network --------------------------------------------------------------
+
+  @nn.compact
+  def __call__(self, images):
+    x = images.astype(self.dtype)
+    reduction_indices = calc_reduction_layers(self.num_cells,
+                                              self.num_reduction_layers)
+    num_stem_cells = 2 if self.stem_type == "imagenet" else 0
+    total_cells = self.num_cells + num_stem_cells + \
+        self.num_reduction_layers
+
+    # Stem (ref: nasnet_model.py:248-291).
+    cell_outputs: List[Optional[jax.Array]] = [None]
+    true_cell_num = 0
+    if self.stem_type == "imagenet":
+      x = self._conv(x, int(32 * self.stem_multiplier), 3, 2,
+                     padding="VALID")
+      x = self._bn(x)
+      cell_outputs.append(x)
+      filter_scaling = 1.0 / (self.filter_scaling_rate ** num_stem_cells)
+      for _ in range(num_stem_cells):
+        x = self._cell(
+            x, cell_outputs[-2], REDUCTION_OPERATIONS,
+            REDUCTION_USED_HIDDENSTATES, REDUCTION_HIDDENSTATE_INDICES,
+            int(self.num_conv_filters * filter_scaling), 2, true_cell_num,
+            total_cells)
+        cell_outputs.append(x)
+        filter_scaling *= self.filter_scaling_rate
+        true_cell_num += 1
+    else:
+      x = self._conv(x, int(self.num_conv_filters * self.stem_multiplier), 3)
+      x = self._bn(x)
+      cell_outputs.append(x)
+
+    aux_head_cell_idx = (reduction_indices[1] - 1
+                         if len(reduction_indices) >= 2 else -1)
+    aux_logits = None
+    filter_scaling = 1.0
+    for cell_num in range(self.num_cells):
+      if self.skip_reduction_layer_input:
+        prev_layer = cell_outputs[-2]
+      if cell_num in reduction_indices:
+        filter_scaling *= self.filter_scaling_rate
+        x = self._cell(
+            x, cell_outputs[-2], REDUCTION_OPERATIONS,
+            REDUCTION_USED_HIDDENSTATES, REDUCTION_HIDDENSTATE_INDICES,
+            int(self.num_conv_filters * filter_scaling), 2, true_cell_num,
+            total_cells)
+        true_cell_num += 1
+        cell_outputs.append(x)
+      if not self.skip_reduction_layer_input:
+        prev_layer = cell_outputs[-2]
+      x = self._cell(
+          x, prev_layer, NORMAL_OPERATIONS, NORMAL_USED_HIDDENSTATES,
+          NORMAL_HIDDENSTATE_INDICES,
+          int(self.num_conv_filters * filter_scaling), 1, true_cell_num,
+          total_cells)
+      true_cell_num += 1
+      if (self.use_aux_head and cell_num == aux_head_cell_idx and
+          self.phase_train):
+        aux_logits = self._aux_head(x)
+      cell_outputs.append(x)
+
+    x = nn.relu(x)
+    x = jnp.mean(x, axis=(1, 2))
+    if self.phase_train and self.dense_dropout_keep_prob < 1.0:
+      x = nn.Dropout(rate=1.0 - self.dense_dropout_keep_prob,
+                     deterministic=False)(x)
+    logits = nn.Dense(self.nclass, dtype=self.dtype,
+                      param_dtype=self.param_dtype)(x)
+    logits = logits.astype(jnp.float32)
+    if aux_logits is not None:
+      aux_logits = aux_logits.astype(jnp.float32)
+    return logits, aux_logits
+
+
+class _NasnetBase(model_lib.CNNModel):
+  """Shared make_module plumbing for the three NASNet configs."""
+
+  _MODULE_KW: dict = {}
+
+  def skip_final_affine_layer(self):
+    return True
+
+  def make_module(self, nclass, phase_train, data_format="NHWC",
+                  dtype=jnp.float32, param_dtype=jnp.float32):
+    del data_format  # NHWC throughout
+    return NasnetModule(nclass=nclass, phase_train=phase_train,
+                        dtype=dtype, param_dtype=param_dtype,
+                        **self._MODULE_KW)
+
+
+class NasnetModel(_NasnetBase):
+  """NASNet-A mobile (ref: nasnet_model.py:535-547; hparams :96-108)."""
+
+  _MODULE_KW = dict(num_cells=12, num_conv_filters=44, stem_multiplier=1.0,
+                    stem_type="imagenet", dense_dropout_keep_prob=0.5,
+                    drop_path_keep_prob=1.0)
+
+  def __init__(self, params=None):
+    super().__init__("nasnet", 224, 32, 0.005, params=params)
+
+
+class NasnetLargeModel(_NasnetBase):
+  """NASNet-A large (ref: nasnet_model.py:550-563; hparams :68-81)."""
+
+  _MODULE_KW = dict(num_cells=18, num_conv_filters=168, stem_multiplier=3.0,
+                    stem_type="imagenet", dense_dropout_keep_prob=0.5,
+                    drop_path_keep_prob=0.7, skip_reduction_layer_input=True)
+
+  def __init__(self, params=None):
+    super().__init__("nasnet", 331, 16, 0.005, params=params)
+
+
+class NasnetCifarModel(_NasnetBase):
+  """NASNet-A cifar (ref: nasnet_model.py:566-578; hparams :36-50)."""
+
+  _MODULE_KW = dict(num_cells=18, num_conv_filters=32, stem_multiplier=3.0,
+                    stem_type="cifar", dense_dropout_keep_prob=1.0,
+                    drop_path_keep_prob=0.6)
+
+  def __init__(self, params=None):
+    super().__init__("nasnet", 32, 32, 0.025, params=params)
+
+
+def create_nasnet_model(params=None):
+  return NasnetModel(params=params)
+
+
+def create_nasnetlarge_model(params=None):
+  return NasnetLargeModel(params=params)
+
+
+def create_nasnet_cifar_model(params=None):
+  return NasnetCifarModel(params=params)

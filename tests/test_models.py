@@ -48,6 +48,41 @@ def test_cifar_model_forward(name):
   assert logits.shape == (2, 10)
 
 
+def test_mobilenet_forward():
+  """MobileNet v2 builds, classifies, and has the expected scale
+  (ref: models/mobilenet_v2.py:188-198)."""
+  model = model_config.get_model_config("mobilenet", "imagenet")
+  (logits, aux), labels, variables, _ = _forward(model, nclass=10, batch=2)
+  assert logits.shape == (2, 10) and aux is None
+  n_params = sum(x.size for x in jax.tree.leaves(variables["params"]))
+  assert 1.5e6 < n_params < 3.5e6  # ~2.2M backbone at multiplier 1.0
+
+
+def test_mobilenet_make_divisible():
+  from kf_benchmarks_tpu.models import mobilenet_v2
+  assert mobilenet_v2.make_divisible(32 * 1.0) == 32
+  assert mobilenet_v2.make_divisible(32 * 0.35) == 16
+  # Never drops more than 10% below the requested width.
+  for c in (24, 32, 64, 96, 160, 320):
+    for m in (0.35, 0.5, 0.75, 1.0, 1.4):
+      assert mobilenet_v2.make_divisible(c * m) >= 0.9 * c * m
+
+
+def test_nasnet_cifar_forward():
+  """NASNet-A cifar builds with an aux head feeding the 0.4-weighted
+  loss (ref: models/nasnet_model.py:566-578, nasnet_utils cells)."""
+  model = model_config.get_model_config("nasnet", "cifar10")
+  (logits, aux), labels, _, _ = _forward(model, nclass=10, batch=2)
+  assert logits.shape == (2, 10)
+  assert aux is not None and aux.shape == (2, 10)
+
+
+def test_nasnet_reduction_layers():
+  from kf_benchmarks_tpu.models import nasnet_model
+  assert nasnet_model.calc_reduction_layers(12, 2) == [4, 8]
+  assert nasnet_model.calc_reduction_layers(18, 2) == [6, 12]
+
+
 def test_inception3_aux_head():
   """The auxiliary head produces aux logits and a 0.4-weighted loss
   contribution (ref: models/model.py:297-302, inception_model.py:95-104)."""
