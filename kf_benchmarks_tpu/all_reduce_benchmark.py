@@ -90,7 +90,7 @@ def run_benchmark(params) -> Dict[str, float]:
   (ref: all_reduce_benchmark.py:155-180 run_benchmark)."""
   from kf_benchmarks_tpu.data import datasets
   model = model_config.get_model_config(params.model, params.data_name)
-  dataset = datasets.create_dataset(None, params.data_name)
+  dataset = datasets.create_dataset(params.data_dir, params.data_name)
   shapes = get_var_shapes(model, nclass=dataset.num_classes)
   devices = mesh_lib.get_devices(params.device, params.num_devices or None)
   mesh = mesh_lib.build_mesh(devices=devices)

@@ -122,6 +122,9 @@ def create_dataset(data_dir: Optional[str],
   """Name->class with dir-name sniffing (ref: datasets.py:232-251)."""
   if not data_dir and not data_name:
     data_name = "imagenet"  # synthetic default (ref :236-237)
+  if data_name == "synthetic":
+    # Accepted wherever model_config accepts it: synthetic imagenet.
+    data_name, data_dir = "imagenet", None
   if data_name is None:
     for name in _DATASETS:
       if name in os.path.basename(data_dir).lower():

@@ -1,0 +1,197 @@
+"""ctypes bindings for the native DCN coordination service (native/kfcoord.cc).
+
+The control-plane replacement for what the reference delegates to
+KungFu's Go runtime + kungfu-run config server (SURVEY 2.9: membership /
+rank assignment, `run_barrier` at ref tf_cnn_benchmarks.py:58-60,
+cluster-size queries at ref benchmark_cnn.py:1408-1410, elastic
+membership in SURVEY 5.3). The XLA SPMD runtime owns the data plane;
+this owns host-side coordination over DCN:
+
+  CoordinatorServer  -- in-process coordinator (rank-0 host runs one)
+  CoordinatorClient  -- join / barrier / kv_put / kv_get / resize
+
+The library is built on demand with ``make -C native`` (g++ is in the
+image; pybind11 is not, hence ctypes).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libkfcoord.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load_library() -> ctypes.CDLL:
+  """Load (building if needed) the native library."""
+  global _lib
+  with _lib_lock:
+    if _lib is not None:
+      return _lib
+    if not os.path.exists(_LIB_PATH):
+      subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                     capture_output=True)
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.kfcoord_server_start.restype = ctypes.c_void_p
+    lib.kfcoord_server_start.argtypes = [ctypes.c_int,
+                                         ctypes.POINTER(ctypes.c_int)]
+    lib.kfcoord_server_stop.argtypes = [ctypes.c_void_p]
+    lib.kfcoord_connect.restype = ctypes.c_void_p
+    lib.kfcoord_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                    ctypes.c_int]
+    lib.kfcoord_close.argtypes = [ctypes.c_void_p]
+    lib.kfcoord_join.restype = ctypes.c_int
+    lib.kfcoord_join.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.POINTER(ctypes.c_int),
+                                 ctypes.POINTER(ctypes.c_long)]
+    lib.kfcoord_cluster_size.restype = ctypes.c_int
+    lib.kfcoord_cluster_size.argtypes = [ctypes.c_void_p]
+    lib.kfcoord_generation.restype = ctypes.c_long
+    lib.kfcoord_generation.argtypes = [ctypes.c_void_p]
+    lib.kfcoord_barrier.restype = ctypes.c_int
+    lib.kfcoord_barrier.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int]
+    lib.kfcoord_kv_put.restype = ctypes.c_int
+    lib.kfcoord_kv_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_char_p]
+    lib.kfcoord_kv_get.restype = ctypes.c_int
+    lib.kfcoord_kv_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_char_p, ctypes.c_int]
+    lib.kfcoord_resize.restype = ctypes.c_long
+    lib.kfcoord_resize.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.kfcoord_leave.restype = ctypes.c_int
+    lib.kfcoord_leave.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+class CoordinatorServer:
+  """In-process coordinator (the config-server role of kungfu-run)."""
+
+  def __init__(self, port: int = 0):
+    lib = _load_library()
+    out_port = ctypes.c_int(0)
+    self._handle = lib.kfcoord_server_start(port, ctypes.byref(out_port))
+    if not self._handle:
+      raise RuntimeError(f"Failed to start coordinator on port {port}")
+    self.port = out_port.value
+
+  def stop(self) -> None:
+    if self._handle:
+      _load_library().kfcoord_server_stop(self._handle)
+      self._handle = None
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.stop()
+
+  def __del__(self):
+    try:
+      self.stop()
+    except Exception:
+      pass
+
+
+class CoordinatorClient:
+  """One worker's connection to the coordinator."""
+
+  def __init__(self, host: str = "127.0.0.1", port: int = 0,
+               timeout_ms: int = 10000):
+    lib = _load_library()
+    self._lib = lib
+    self._handle = lib.kfcoord_connect(host.encode(), port, timeout_ms)
+    if not self._handle:
+      raise RuntimeError(f"Failed to connect to coordinator {host}:{port}")
+    self.rank: Optional[int] = None
+    self.size: Optional[int] = None
+    self.generation: Optional[int] = None
+
+  def join(self, name: str) -> int:
+    """Register and get a stable rank (idempotent per name)."""
+    size = ctypes.c_int(0)
+    gen = ctypes.c_long(0)
+    rank = self._lib.kfcoord_join(self._handle, name.encode(),
+                                  ctypes.byref(size), ctypes.byref(gen))
+    if rank < 0:
+      raise RuntimeError("JOIN failed")
+    self.rank, self.size, self.generation = rank, size.value, gen.value
+    return rank
+
+  def cluster_size(self) -> int:
+    n = self._lib.kfcoord_cluster_size(self._handle)
+    if n < 0:
+      raise RuntimeError("SIZE failed")
+    return n
+
+  def current_generation(self) -> int:
+    g = self._lib.kfcoord_generation(self._handle)
+    if g < 0:
+      raise RuntimeError("GEN failed")
+    return g
+
+  def barrier(self, name: str, count: int) -> None:
+    """Block until ``count`` participants enter barrier ``name``
+    (the run_barrier analog, ref: tf_cnn_benchmarks.py:58-60)."""
+    if self._lib.kfcoord_barrier(self._handle, name.encode(), count) != 0:
+      raise RuntimeError(f"BARRIER {name} failed")
+
+  def kv_put(self, key: str, value: bytes) -> None:
+    # "x" prefix keeps the token non-empty (protocol is space-delimited)
+    # and distinguishes hex payloads from raw tokens like RESIZE's
+    # decimal target size.
+    if self._lib.kfcoord_kv_put(self._handle, key.encode(),
+                                ("x" + value.hex()).encode()) != 0:
+      raise RuntimeError(f"PUT {key} failed")
+
+  def _kv_get_raw(self, key: str, max_len: int = 1 << 20) -> str:
+    buf = ctypes.create_string_buffer(max_len)
+    n = self._lib.kfcoord_kv_get(self._handle, key.encode(), buf, max_len)
+    if n == -2:
+      raise ValueError(f"value for {key} exceeds {max_len} bytes")
+    if n < 0:
+      raise RuntimeError(f"GET {key} failed")
+    return buf.value.decode()
+
+  def kv_get(self, key: str, max_len: int = 1 << 20) -> bytes:
+    """Blocking fetch (bootstrap exchange: workers GET what rank 0 PUT)."""
+    token = self._kv_get_raw(key, max_len)
+    return bytes.fromhex(token[1:]) if token.startswith("x") else \
+        token.encode()
+
+  def resize(self, new_size: int) -> int:
+    """Request an elastic resize; returns the new generation
+    (SURVEY 5.3: config-server-driven cluster resize)."""
+    gen = self._lib.kfcoord_resize(self._handle, new_size)
+    if gen < 0:
+      raise RuntimeError("RESIZE failed")
+    return gen
+
+  def target_size(self) -> int:
+    """The most recently requested elastic target size (blocks until a
+    RESIZE has been issued)."""
+    return int(self._kv_get_raw("__target_size__"))
+
+  def leave(self) -> None:
+    self._lib.kfcoord_leave(self._handle)
+
+  def close(self) -> None:
+    if self._handle:
+      self._lib.kfcoord_close(self._handle)
+      self._handle = None
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()

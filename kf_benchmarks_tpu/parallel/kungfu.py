@@ -19,6 +19,7 @@ runtime plus the native coordination service in native/ (control plane).
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
@@ -49,7 +50,22 @@ def current_rank() -> int:
 
 
 def run_barrier() -> None:
-  """Global barrier before exit (ref: tf_cnn_benchmarks.py:58-60)."""
+  """Global barrier before exit (ref: tf_cnn_benchmarks.py:58-60).
+
+  Under the kfrun launcher (KFCOORD_HOST/PORT/WORLD set) the barrier
+  rides the native coordination service over DCN; under multi-process
+  JAX it uses sync_global_devices; single-process it is a no-op.
+  """
+  host = os.environ.get("KFCOORD_HOST")
+  port = os.environ.get("KFCOORD_PORT")
+  world = os.environ.get("KFCOORD_WORLD")
+  if host and port and world:
+    from kf_benchmarks_tpu.parallel import coordination
+    with coordination.CoordinatorClient(host=host,
+                                        port=int(port)) as client:
+      client.join(os.environ.get("KFCOORD_NAME", f"proc-{os.getpid()}"))
+      client.barrier("kf_exit", int(world))
+    return
   if jax.process_count() > 1:
     from jax.experimental import multihost_utils
     multihost_utils.sync_global_devices("kf_benchmarks_tpu_exit_barrier")

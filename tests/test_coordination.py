@@ -1,0 +1,177 @@
+"""Tests for the native DCN coordination service (native/kfcoord.cc via
+kf_benchmarks_tpu/parallel/coordination.py).
+
+Covers the KungFu control-plane capabilities the reference consumes
+(SURVEY 2.9): membership/rank, exit barrier, bootstrap broadcast (KV),
+and elastic resize generations. Multi-process flows use subprocess
+workers on localhost, mirroring how the reference tests distributed
+modes (ref: benchmark_cnn_distributed_test.py:74-101).
+"""
+
+import concurrent.futures
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+coordination = pytest.importorskip(
+    "kf_benchmarks_tpu.parallel.coordination")
+
+
+@pytest.fixture()
+def server():
+  with coordination.CoordinatorServer() as s:
+    yield s
+
+
+def test_join_assigns_dense_ranks(server):
+  clients = [coordination.CoordinatorClient(port=server.port)
+             for _ in range(4)]
+  try:
+    ranks = [c.join(f"worker-{i}") for i, c in enumerate(clients)]
+    assert sorted(ranks) == [0, 1, 2, 3]
+    assert clients[0].cluster_size() == 4
+  finally:
+    for c in clients:
+      c.close()
+
+
+def test_rejoin_is_idempotent(server):
+  with coordination.CoordinatorClient(port=server.port) as c1:
+    r1 = c1.join("w0")
+    # Same name from a new connection (reconnect after coordinator or
+    # network hiccup) keeps the rank.
+    with coordination.CoordinatorClient(port=server.port) as c2:
+      assert c2.join("w0") == r1
+      assert c2.cluster_size() == 1
+
+
+def test_barrier_blocks_until_full(server):
+  n = 4
+  order = []
+
+  def worker(i):
+    with coordination.CoordinatorClient(port=server.port) as c:
+      c.join(f"w{i}")
+      c.barrier("exit", n)
+      order.append(i)
+      return i
+
+  with concurrent.futures.ThreadPoolExecutor(n) as ex:
+    results = list(ex.map(worker, range(n)))
+  assert sorted(results) == list(range(n))
+  assert len(order) == n
+
+
+def test_barrier_reusable(server):
+  """The same named barrier works across successive rounds (per-step
+  sync barrier semantics, ref: benchmark_cnn.py:3241-3273)."""
+  n = 2
+
+  def worker(i):
+    with coordination.CoordinatorClient(port=server.port) as c:
+      c.join(f"w{i}")
+      for _ in range(3):
+        c.barrier("step", n)
+      return True
+
+  with concurrent.futures.ThreadPoolExecutor(n) as ex:
+    assert all(ex.map(worker, range(n)))
+
+
+def test_kv_broadcast_bootstrap(server):
+  """Rank-0 PUTs, later joiners GET (broadcast-at-init analog,
+  ref: benchmark_cnn.py:2097-2100)."""
+  payload = bytes(range(256))
+  with coordination.CoordinatorClient(port=server.port) as c0:
+    c0.join("w0")
+    c0.kv_put("init_digest", payload)
+    with coordination.CoordinatorClient(port=server.port) as c1:
+      c1.join("w1")
+      assert c1.kv_get("init_digest") == payload
+
+
+def test_kv_get_blocks_for_late_put(server):
+  def getter():
+    with coordination.CoordinatorClient(port=server.port) as c:
+      return c.kv_get("late_key")
+
+  with concurrent.futures.ThreadPoolExecutor(1) as ex:
+    fut = ex.submit(getter)
+    import time
+    time.sleep(0.2)
+    assert not fut.done()  # still blocked on the missing key
+    with coordination.CoordinatorClient(port=server.port) as c:
+      c.kv_put("late_key", b"value")
+    assert fut.result(timeout=5) == b"value"
+
+
+def test_empty_value_roundtrip(server):
+  with coordination.CoordinatorClient(port=server.port) as c:
+    c.kv_put("empty", b"")
+    assert c.kv_get("empty") == b""
+
+
+def test_resize_bumps_generation(server):
+  with coordination.CoordinatorClient(port=server.port) as c:
+    c.join("w0")
+    g0 = c.current_generation()
+    g1 = c.resize(8)
+    assert g1 > g0
+    assert c.target_size() == 8
+    assert c.current_generation() == g1
+
+
+def test_leave_shrinks_membership(server):
+  c0 = coordination.CoordinatorClient(port=server.port)
+  c1 = coordination.CoordinatorClient(port=server.port)
+  c0.join("w0")
+  c1.join("w1")
+  assert c0.cluster_size() == 2
+  g = c0.current_generation()
+  c1.leave()
+  c1.close()
+  assert c0.cluster_size() == 1
+  assert c0.current_generation() > g  # membership change is visible
+  c0.close()
+
+
+_WORKER_SCRIPT = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    from kf_benchmarks_tpu.parallel import coordination
+    port, name, n = int(sys.argv[1]), sys.argv[2], int(sys.argv[3])
+    with coordination.CoordinatorClient(port=port) as c:
+        rank = c.join(name)
+        c.kv_put(f"addr/{{rank}}", f"host-{{name}}".encode())
+        c.barrier("ready", n)
+        peer = c.kv_get(f"addr/{{(rank + 1) % n}}").decode()
+        c.barrier("exit", n)
+        print(f"{{rank}}:{{peer}}")
+""")
+
+
+def test_multiprocess_bootstrap(server, tmp_path):
+  """Full kungfu-run-style flow across real OS processes: join, address
+  exchange through the KV store, barriers, clean exit."""
+  import os
+  repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+  n = 3
+  procs = [
+      subprocess.Popen(
+          [sys.executable, "-c", _WORKER_SCRIPT.format(repo=repo),
+           str(server.port), f"w{i}", str(n)],
+          stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+      for i in range(n)]
+  outs = []
+  for p in procs:
+    out, err = p.communicate(timeout=60)
+    assert p.returncode == 0, f"worker failed: {err}"
+    outs.append(out.strip())
+  ranks = sorted(int(o.split(":")[0]) for o in outs)
+  assert ranks == list(range(n))
+  # Every worker resolved its ring neighbor's address.
+  for o in outs:
+    rank, peer = o.split(":")
+    assert peer.startswith("host-w")

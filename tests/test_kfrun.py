@@ -1,0 +1,47 @@
+"""kfrun launcher test: N real processes coordinate and exit cleanly
+(the kungfu-run contract, ref: README.md "Running KungFu")."""
+
+import os
+import sys
+
+import pytest
+
+from kf_benchmarks_tpu import kfrun
+
+_WORKER = """
+import os, sys
+sys.path.insert(0, os.environ["KF_REPO"])
+from kf_benchmarks_tpu.parallel import coordination
+with coordination.CoordinatorClient(
+    host=os.environ["KFCOORD_HOST"],
+    port=int(os.environ["KFCOORD_PORT"])) as c:
+    rank = c.join(os.environ["KFCOORD_NAME"])
+    print(f"rank={rank} world={os.environ['KFCOORD_WORLD']}")
+# run_barrier-equivalent at exit:
+from kf_benchmarks_tpu.parallel import kungfu
+kungfu.run_barrier()
+"""
+
+
+def test_kfrun_spawns_and_barriers(tmp_path):
+  repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+  rc = kfrun.launch(
+      3, [sys.executable, "-c", _WORKER], logdir=str(tmp_path),
+      extra_env={"KF_REPO": repo})
+  assert rc == 0
+  # Per-process logs with the kungfu-run naming scheme exist and carry
+  # the expected ranks.
+  ranks = set()
+  for i in range(3):
+    log = tmp_path / f"127.0.0.1.{10000 + i}.stdout.log"
+    assert log.exists()
+    line = log.read_text().strip()
+    assert "world=3" in line
+    ranks.add(int(line.split()[0].split("=")[1]))
+  assert ranks == {0, 1, 2}
+
+
+def test_kfrun_propagates_failure(tmp_path):
+  rc = kfrun.launch(2, [sys.executable, "-c", "import sys; sys.exit(7)"],
+                    logdir=str(tmp_path))
+  assert rc == 7
