@@ -19,6 +19,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from kf_benchmarks_tpu import checkpoint
+from kf_benchmarks_tpu import elastic as elastic_lib
 from kf_benchmarks_tpu import learning_rate
 from kf_benchmarks_tpu import optimizers
 from kf_benchmarks_tpu import train_step as train_step_lib
@@ -71,7 +72,13 @@ class BenchmarkCNN:
     from kf_benchmarks_tpu import params as params_lib
     params_lib.validate_params(params)
     validation.validate_cross_flags(params)
+    if params.adaptive_batch_size and not params.track_grad_noise_scale:
+      # The adaptive-batch policy keys on the measured noise scale.
+      params = params._replace(track_grad_noise_scale=True)
     self.params = params
+    # Optional resize driver (tests inject a ScheduledController; the
+    # elastic flag wires the coordination service via KFCOORD_* env).
+    self.elastic_controller = None
     self.dataset = dataset or datasets.create_dataset(
         params.data_dir, params.data_name)
     self.model = model or model_config.get_model_config(
@@ -197,7 +204,10 @@ class BenchmarkCNN:
           train=(subset == "train") and not (p.eval or p.forward_only),
           distortions=bool(p.distortions),
           resize_method=p.resize_method,
-          seed=(p.tf_random_seed or 301) + kungfu.current_rank(),
+          # The incarnation term reshuffles after each elastic reshape so
+          # reopened streams do not replay the dataset's leading examples.
+          seed=((p.tf_random_seed or 301) + kungfu.current_rank() +
+                7919 * getattr(self, "_input_incarnation", 0)),
           shift_ratio=(kungfu.current_rank() /
                        max(kungfu.current_cluster_size(), 1)),
           num_threads=p.datasets_num_private_threads or 8)
@@ -227,12 +237,52 @@ class BenchmarkCNN:
     init_state, train_step, eval_step, broadcast_init = self._build()
     rng = jax.random.PRNGKey(p.tf_random_seed or 0)
     data_rng, init_rng = jax.random.split(rng)
-    next_batch, stop_input = self._input_iterator(data_rng, "train")
+    self._data_rng = data_rng
+    next_batch = self._open_input(data_rng, "train")
     try:
       return self._train_loop(init_state, train_step, eval_step,
                               broadcast_init, init_rng, next_batch)
     finally:
-      stop_input()
+      self._input_stop()
+
+  def _open_input(self, rng, subset: str):
+    """Open a fresh input stream, closing the previous one (elastic
+    reshapes swap streams mid-run)."""
+    stop_prev = getattr(self, "_input_stop", None)
+    if stop_prev is not None:
+      stop_prev()
+      self._input_incarnation = getattr(self, "_input_incarnation", 0) + 1
+      rng = jax.random.fold_in(rng, self._input_incarnation)
+    next_batch, stop = self._input_iterator(rng, subset)
+    self._input_stop = stop
+    return next_batch
+
+  def _reshape_topology(self, state, num_devices: int,
+                        batch_per_device: int, init_rng):
+    """Elastic rescale: rebuild mesh + jitted steps for a new topology and
+    carry training state across via the checkpoint snapshot/restore path
+    (SURVEY 7.4: XLA programs are topology-fixed, so resize == re-jit +
+    state re-shard; the KungFu resize_cluster analog).
+    """
+    # State-dict form, the same shape restore_state consumes when reading
+    # a checkpoint file (namedtuple opt states become plain dicts).
+    from flax import serialization
+    snapshot = serialization.to_state_dict(checkpoint.savable_state(state))
+    self.num_devices = num_devices
+    self.params = self.params._replace(num_devices=num_devices)
+    self.batch_size_per_device = batch_per_device
+    self.model.set_batch_size(batch_per_device)
+    self.batch_size = batch_per_device * num_devices
+    self.mesh = mesh_lib.build_mesh(num_devices, self.params.device)
+    init_state, train_step, eval_step, broadcast_init = self._build()
+    next_batch = self._open_input(self._data_rng, "train")
+    shape = (batch_per_device,) + self._model_image_shape()
+    new_state = jax.jit(init_state)(init_rng,
+                                    jnp.zeros(shape, jnp.float32))
+    new_state = checkpoint.restore_state(new_state, snapshot)
+    new_state = new_state.replace(
+        params=broadcast_init(new_state.params))
+    return new_state, train_step, eval_step, next_batch
 
   def _train_loop(self, init_state, train_step, eval_step, broadcast_init,
                   init_rng, next_batch) -> Dict[str, Any]:
@@ -264,13 +314,37 @@ class BenchmarkCNN:
     jax.block_until_ready(state.params)
     log_fn("Initialization: %.1f s" % (time.time() - t0))
 
-    if p.forward_only:
-      # Forward-only benchmarks inference speed: no gradients, no
-      # optimizer, eval-phase module (ref: benchmark_cnn.py:124-126).
-      def run_step(state, images, labels):
-        return state, eval_step(state, images, labels)
-    else:
-      run_step = train_step
+    def make_run_step(train_step, eval_step):
+      if p.forward_only:
+        # Forward-only benchmarks inference speed: no gradients, no
+        # optimizer, eval-phase module (ref: benchmark_cnn.py:124-126).
+        def run_step(state, images, labels):
+          return state, eval_step(state, images, labels)
+        return run_step
+      return train_step
+
+    run_step = make_run_step(train_step, eval_step)
+
+    # Elastic / adaptive-batch drivers (north-star KungFu capabilities;
+    # see elastic.py).
+    noise_ema = (elastic_lib.NoiseScaleEMA()
+                 if p.track_grad_noise_scale else None)
+    if noise_ema is not None and self.num_devices < 2:
+      # The estimator contrasts per-replica vs replica-mean gradients;
+      # with one replica there is no contrast and no metrics are emitted.
+      log_fn("track_grad_noise_scale: needs >= 2 devices, no estimates "
+             "will be produced (adaptive_batch_size will hold steady)")
+    batch_policy = (elastic_lib.AdaptiveBatchPolicy(
+        p.adaptive_batch_min, p.adaptive_batch_max)
+        if p.adaptive_batch_size else None)
+    controller = self.elastic_controller
+    if controller is None and p.elastic:
+      controller = elastic_lib.ElasticController.from_env(
+          max_devices=len(mesh_lib.get_devices(p.device)))
+      if controller is None:
+        log_fn("elastic: no coordination service in env (KFCOORD_*); "
+               "resize polling disabled")
+    reshape_events = []
 
     log_fn("Running warm up")
     t0 = time.time()
@@ -289,6 +363,7 @@ class BenchmarkCNN:
     step_train_times = []
     loss = float("nan")
     stopped_early = False
+    images_processed = 0
     last_save_time = time.time()
     loop_start = time.time()
     for i in range(self.num_batches):
@@ -297,6 +372,10 @@ class BenchmarkCNN:
       loss = float(metrics[p.loss_type_to_report])  # sync point, as sess.run
       images, labels = next_batch()
       step_train_times.append(time.time() - t0)
+      images_processed += self.batch_size * max(self.num_workers, 1)
+      if noise_ema is not None and "noise_scale_g2" in metrics:
+        noise_ema.update(float(metrics["noise_scale_g2"]),
+                         float(metrics["noise_scale_s"]))
       if (i + 1) % self.display_every == 0 or (i + 1) == self.num_batches:
         top1 = (float(metrics["top_1_accuracy"])
                 if "top_1_accuracy" in metrics else None)
@@ -325,12 +404,50 @@ class BenchmarkCNN:
                  f">= {p.stop_at_top_1_accuracy}")
           stopped_early = True
           break
+      # Elastic resize / adaptive batch (north-star KungFu capabilities;
+      # SURVEY 2.9, 5.3). Polled at a fixed cadence to keep the hot loop
+      # collective-free.
+      if ((controller is not None or batch_policy is not None) and
+          (i + 1) % p.elastic_check_every_n_steps == 0 and
+          (i + 1) < self.num_batches):
+        new_n = None
+        if controller is not None:
+          poll_at = getattr(controller, "poll_at", None)
+          new_n = poll_at(i + 1) if poll_at else controller.poll()
+          if new_n == self.num_devices:
+            new_n = None
+        new_bs = None
+        if batch_policy is not None and noise_ema is not None:
+          proposed = batch_policy.propose(
+              self.batch_size_per_device, noise_ema.b_simple,
+              new_n or self.num_devices)
+          if proposed != self.batch_size_per_device:
+            new_bs = proposed
+        if new_n or new_bs:
+          event = {"step": i + 1,
+                   "num_devices": new_n or self.num_devices,
+                   "batch_size_per_device":
+                       new_bs or self.batch_size_per_device,
+                   "b_simple": noise_ema.b_simple if noise_ema else None}
+          log_fn("Elastic reshape at step %d: devices %d -> %d, "
+                 "per-device batch %d -> %d" % (
+                     i + 1, self.num_devices, event["num_devices"],
+                     self.batch_size_per_device,
+                     event["batch_size_per_device"]))
+          state, train_step, eval_step, next_batch = \
+              self._reshape_topology(state, event["num_devices"],
+                                     event["batch_size_per_device"],
+                                     init_rng)
+          run_step = make_run_step(train_step, eval_step)
+          images, labels = next_batch()
+          reshape_events.append(event)
     total_time = time.time() - loop_start
+    if controller is not None and controller is not self.elastic_controller:
+      controller.close()
 
     num_steps = len(step_train_times)
     average_wall_time = total_time / num_steps if num_steps else 0
-    images_per_sec = (num_steps * self.batch_size *
-                      max(self.num_workers, 1) / total_time)
+    images_per_sec = images_processed / total_time
     log_fn("-" * 64)
     log_fn("total images/sec: %.2f" % images_per_sec)
     log_fn("-" * 64)
@@ -347,6 +464,8 @@ class BenchmarkCNN:
         "images_per_sec": images_per_sec,
         "last_average_loss": loss,
         "stopped_early": stopped_early,
+        "reshape_events": reshape_events,
+        "grad_noise_scale": noise_ema.b_simple if noise_ema else None,
         "state": state,
     }
 

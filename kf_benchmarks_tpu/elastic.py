@@ -1,0 +1,182 @@
+"""Elastic scaling + adaptive batch size (the KungFu north-star features).
+
+The reference delegates these to KungFu's external runtime: config-server
+driven cluster resize and policy-driven hyperparameter adaptation fed by
+gradient-noise-scale monitoring inside the collective ops (SURVEY 2.9
+"elastic scaling / adaptive batch size", 5.3). Nothing in the reference
+repo implements them; this module designs them TPU-natively:
+
+* **Gradient noise scale** is measured inside the jitted train step
+  (kf_benchmarks_tpu/train_step.py) from quantities the data-parallel
+  step already has: per-replica gradients (small-batch estimate) vs the
+  replica-mean gradient (large-batch estimate). Host-side EMAs turn the
+  per-step estimates into the "simple noise scale" B_simple of
+  McCandlish et al., "An Empirical Model of Large-Batch Training"
+  (arXiv:1812.06162) -- the statistic KungFu's adaptation policies key on.
+* **AdaptiveBatchPolicy** proposes a per-device batch size tracking
+  B_simple with hysteresis (only power-of-two jumps, bounded range) so
+  recompiles stay rare.
+* **ElasticController** watches the native coordination service
+  (native/kfcoord.cc) for generation bumps and returns the new target
+  device count; the benchmark driver re-builds mesh + jitted steps and
+  carries state across via the checkpoint snapshot/restore path
+  ("checkpointed rescale", SURVEY 7.4: XLA programs are compiled for a
+  fixed topology, so resize == re-jit + state re-shard).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+# -- in-step measurement (called from train_step inside shard_map) ----------
+
+def noise_scale_stats(local_grads, axis_name, batch_size_per_replica: int):
+  """Per-step (g2, s) estimates from per-replica vs replica-mean grads.
+
+  With B_small = per-replica batch and B_big = global batch, the unbiased
+  pair (arXiv:1812.06162 appendix A):
+      g2 = (B_big*|G_big|^2 - B_small*E|G_small|^2) / (B_big - B_small)
+      s  = (E|G_small|^2 - |G_big|^2) / (1/B_small - 1/B_big)
+  and B_simple = s / g2 (host-side, after EMA smoothing).
+  """
+  n = lax.axis_size(axis_name)
+  mean_grads = jax.tree.map(lambda g: lax.pmean(g, axis_name), local_grads)
+  sq = lambda t: sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                     for x in jax.tree.leaves(t))
+  local_sq = lax.pmean(sq(local_grads), axis_name)   # E|G_small|^2
+  mean_sq = sq(mean_grads)                           # |G_big|^2
+  b_small = float(batch_size_per_replica)
+  b_big = b_small * n
+  g2 = (b_big * mean_sq - b_small * local_sq) / (b_big - b_small)
+  s = (local_sq - mean_sq) / (1.0 / b_small - 1.0 / b_big)
+  return g2, s
+
+
+# -- host-side smoothing -----------------------------------------------------
+
+class NoiseScaleEMA:
+  """EMA of the (g2, s) pair; B_simple = s_ema / g2_ema.
+
+  Separate EMAs of numerator and denominator (not of the ratio) per
+  arXiv:1812.06162 appendix A.3 -- the per-step ratio is wildly noisy.
+  """
+
+  def __init__(self, decay: float = 0.9):
+    self.decay = decay
+    self._g2 = None
+    self._s = None
+
+  def update(self, g2: float, s: float) -> None:
+    if not (jnp.isfinite(g2) and jnp.isfinite(s)):
+      return
+    if self._g2 is None:
+      self._g2, self._s = float(g2), float(s)
+    else:
+      d = self.decay
+      self._g2 = d * self._g2 + (1 - d) * float(g2)
+      self._s = d * self._s + (1 - d) * float(s)
+
+  @property
+  def b_simple(self) -> Optional[float]:
+    if self._g2 is None or self._g2 <= 0:
+      return None
+    return max(self._s / self._g2, 0.0)
+
+
+class AdaptiveBatchPolicy:
+  """Propose a per-device batch size tracking the noise scale.
+
+  KungFu's adaptive-batch policy grows the global batch as the gradient
+  noise scale grows during training; here the proposal is
+  B_simple / num_devices snapped to the nearest power of two within
+  [min_batch, max_batch], with 2x hysteresis so the jitted step is only
+  rebuilt on material changes.
+  """
+
+  def __init__(self, min_batch: int, max_batch: int):
+    if min_batch < 1 or max_batch < min_batch:
+      raise ValueError(f"invalid batch bounds [{min_batch}, {max_batch}]")
+    self.min_batch = min_batch
+    self.max_batch = max_batch
+
+  def propose(self, current: int, b_simple: Optional[float],
+              num_devices: int) -> int:
+    if not b_simple or b_simple <= 0:
+      return current
+    target = max(b_simple / max(num_devices, 1), 1.0)
+    # Snap to a power of two in bounds.
+    snapped = 1 << max(round(float(jnp.log2(target))), 0)
+    snapped = min(max(snapped, self.min_batch), self.max_batch)
+    # Hysteresis: only move on >= 2x difference, and one octave at a time.
+    if snapped >= current * 2:
+      return current * 2
+    if snapped * 2 <= current:
+      return max(current // 2, self.min_batch)
+    return current
+
+
+# -- elastic membership ------------------------------------------------------
+
+class ElasticController:
+  """Polls the coordination service for resize requests.
+
+  One client per process; ``poll()`` returns the new target device count
+  when the coordinator's generation advanced past the last seen one, else
+  None. Targets are clamped to the locally visible device count (on a
+  real pod the membership service spans hosts; in-process we scale within
+  the local mesh).
+  """
+
+  def __init__(self, client, max_devices: int):
+    self._client = client
+    self._max_devices = max_devices
+    self._last_target: Optional[int] = None
+
+  @classmethod
+  def from_env(cls, max_devices: int) -> Optional["ElasticController"]:
+    host = os.environ.get("KFCOORD_HOST")
+    port = os.environ.get("KFCOORD_PORT")
+    if not (host and port):
+      return None
+    from kf_benchmarks_tpu.parallel import coordination
+    try:
+      client = coordination.CoordinatorClient(host=host, port=int(port),
+                                              timeout_ms=2000)
+    except RuntimeError:
+      return None  # coordinator gone; run without elastic polling
+    return cls(client, max_devices)
+
+  def poll(self) -> Optional[int]:
+    """Non-blocking: the new target device count if a RESIZE was issued
+    since the last poll (including any issued before this controller
+    started), else None."""
+    try:
+      target = self._client.try_target_size()
+    except Exception:
+      return None
+    if target is None or target == self._last_target:
+      return None
+    self._last_target = target
+    return max(1, min(target, self._max_devices))
+
+  def close(self) -> None:
+    close = getattr(self._client, "close", None)
+    if close:
+      close()
+
+
+class ScheduledController:
+  """Deterministic resize schedule {step: num_devices} -- the test/AB
+  harness analog of coordinator-driven resizes."""
+
+  def __init__(self, schedule: dict):
+    self.schedule = dict(schedule)
+
+  def poll_at(self, step: int) -> Optional[int]:
+    return self.schedule.pop(step, None)

@@ -58,8 +58,35 @@ def launch(np_: int, command: List[str], logdir: str = ".",
       log_files += [out, err]
       procs.append(subprocess.Popen(command, env=env, stdout=out,
                                     stderr=err))
-    exit_codes = [p.wait() for p in procs]
-    return max(abs(c) for c in exit_codes)
+    # Monitor rather than blindly wait: if one worker dies abnormally
+    # while its siblings are parked in the exit barrier, the barrier can
+    # never fill -- tear the job down instead of hanging (the
+    # kungfu-run failure contract).
+    import time
+    while True:
+      codes = [p.poll() for p in procs]
+      if all(c is not None for c in codes):
+        break
+      if any(c not in (None, 0) for c in codes):
+        time.sleep(1.0)  # grace: let siblings exit on their own
+        for p in procs:
+          if p.poll() is None:
+            p.terminate()
+        for p in procs:
+          try:
+            p.wait(timeout=10)
+          except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+        codes = [p.poll() for p in procs]
+        break
+      time.sleep(0.1)
+    # Report the original failure, not the SIGTERM we delivered: a worker
+    # killed by our teardown shows -15, which would mask the real code.
+    failures = [c for c in codes if c not in (0, -signal.SIGTERM)]
+    if failures:
+      return max(abs(c) for c in failures)
+    return 1 if any(c == -signal.SIGTERM for c in codes) else 0
   except KeyboardInterrupt:
     for p in procs:
       p.send_signal(signal.SIGTERM)

@@ -37,7 +37,11 @@ def _load_library() -> ctypes.CDLL:
   with _lib_lock:
     if _lib is not None:
       return _lib
-    if not os.path.exists(_LIB_PATH):
+    src = os.path.join(_NATIVE_DIR, "kfcoord.cc")
+    stale = (not os.path.exists(_LIB_PATH) or
+             (os.path.exists(src) and
+              os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)))
+    if stale:
       subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
                      capture_output=True)
     lib = ctypes.CDLL(_LIB_PATH)
@@ -66,6 +70,9 @@ def _load_library() -> ctypes.CDLL:
     lib.kfcoord_kv_get.restype = ctypes.c_int
     lib.kfcoord_kv_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                    ctypes.c_char_p, ctypes.c_int]
+    lib.kfcoord_kv_tryget.restype = ctypes.c_int
+    lib.kfcoord_kv_tryget.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_char_p, ctypes.c_int]
     lib.kfcoord_resize.restype = ctypes.c_long
     lib.kfcoord_resize.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.kfcoord_leave.restype = ctypes.c_int
@@ -169,6 +176,20 @@ class CoordinatorClient:
     return bytes.fromhex(token[1:]) if token.startswith("x") else \
         token.encode()
 
+  def _kv_tryget_raw(self, key: str,
+                     max_len: int = 1 << 20) -> Optional[str]:
+    """Non-blocking probe; None when the key is absent."""
+    buf = ctypes.create_string_buffer(max_len)
+    n = self._lib.kfcoord_kv_tryget(self._handle, key.encode(), buf,
+                                    max_len)
+    if n == -3:
+      return None
+    if n == -2:
+      raise ValueError(f"value for {key} exceeds {max_len} bytes")
+    if n < 0:
+      raise RuntimeError(f"TRYGET {key} failed")
+    return buf.value.decode()
+
   def resize(self, new_size: int) -> int:
     """Request an elastic resize; returns the new generation
     (SURVEY 5.3: config-server-driven cluster resize)."""
@@ -181,6 +202,11 @@ class CoordinatorClient:
     """The most recently requested elastic target size (blocks until a
     RESIZE has been issued)."""
     return int(self._kv_get_raw("__target_size__"))
+
+  def try_target_size(self) -> Optional[int]:
+    """Non-blocking variant; None when no RESIZE was ever issued."""
+    token = self._kv_tryget_raw("__target_size__")
+    return int(token) if token is not None else None
 
   def leave(self) -> None:
     self._lib.kfcoord_leave(self._handle)

@@ -156,6 +156,30 @@ flags.DEFINE_boolean("use_xla_compile", True,
 flags.DEFINE_boolean("sync_on_finish", False,
                      "Barrier across workers at exit (ref :567-569; KungFu "
                      "run_barrier analog, ref tf_cnn_benchmarks.py:58-60).")
+flags.DEFINE_boolean("track_grad_noise_scale", False,
+                     "Measure the gradient noise scale in the train step "
+                     "(per-replica vs replica-mean gradients) and report "
+                     "the EMA-smoothed B_simple -- the statistic KungFu's "
+                     "adaptation policies monitor (SURVEY 2.9 north star).")
+flags.DEFINE_boolean("elastic", False,
+                     "Enable elastic resize: watch the coordination "
+                     "service (KFCOORD_* env) for target-size changes and "
+                     "re-jit over the new device mesh, carrying state via "
+                     "checkpointed rescale (KungFu resize_cluster analog).")
+flags.DEFINE_integer("elastic_check_every_n_steps", 10,
+                     "How often the train loop polls for elastic resize / "
+                     "adaptive-batch decisions.", lower_bound=1)
+flags.DEFINE_boolean("adaptive_batch_size", False,
+                     "Adapt the per-device batch size to the measured "
+                     "gradient noise scale (implies "
+                     "track_grad_noise_scale; KungFu adaptive batch "
+                     "policy analog).")
+flags.DEFINE_integer("adaptive_batch_min", 1,
+                     "Lower bound for the adaptive per-device batch size.",
+                     lower_bound=1)
+flags.DEFINE_integer("adaptive_batch_max", 1024,
+                     "Upper bound for the adaptive per-device batch size.",
+                     lower_bound=1)
 flags.DEFINE_boolean("cross_replica_sync", True,
                      "Synchronous data-parallel updates (ref :520-522).")
 flags.DEFINE_string("train_dir", None,

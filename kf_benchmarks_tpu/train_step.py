@@ -35,6 +35,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 import flax
 import optax
 
+from kf_benchmarks_tpu import elastic as elastic_lib
 from kf_benchmarks_tpu.parallel.mesh import REPLICA_AXIS
 
 
@@ -167,6 +168,15 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
         loss_fn, has_aux=True)(model_params)
     if use_loss_scale or auto_loss_scale:
       grads = jax.tree.map(lambda g: g / state.loss_scale, grads)
+    noise_stats = None
+    if params.track_grad_noise_scale and num_replicas > 1:
+      # Measured on the pre-reduction per-replica grads (the small-batch
+      # estimate) vs their replica mean (the large-batch estimate); see
+      # elastic.noise_scale_stats. This is the in-collective monitoring
+      # KungFu's runtime does (SURVEY 2.9 "monitored gradient noise
+      # scale").
+      noise_stats = elastic_lib.noise_scale_stats(
+          grads, REPLICA_AXIS, images.shape[0])
     grads = strategy.reduce_gradients(grads, REPLICA_AXIS)
 
     model_params_pre = strategy.pre_update(model_params, state.step,
@@ -214,6 +224,8 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
     if params.print_training_accuracy:
       acc = model.accuracy_function(net_result, labels)
       metrics.update({k: lax.pmean(v, REPLICA_AXIS) for k, v in acc.items()})
+    if noise_stats is not None:
+      metrics["noise_scale_g2"], metrics["noise_scale_s"] = noise_stats
 
     new_state = TrainState(
         step=state.step + 1,

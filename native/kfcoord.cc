@@ -67,7 +67,8 @@ struct ServerState {
   std::atomic<bool> stopping{false};
   int listen_fd = -1;
   int port = 0;
-  std::vector<std::thread> conn_threads;
+  int active_conns = 0;  // detached handler threads still running
+  std::set<int> conn_fds;  // open connections, for shutdown-on-stop
   std::thread accept_thread;
 };
 
@@ -130,6 +131,12 @@ void handle_connection(ServerState* st, int fd) {
       std::string name;
       long count = 0;
       in >> name >> count;
+      if (name.empty() || count < 1) {
+        // A zero/garbled count would make ++counts >= count instantly
+        // true and release legitimately parked waiters early.
+        if (!send_all(fd, "ERR bad-barrier-count\n")) break;
+        continue;
+      }
       std::unique_lock<std::mutex> lk(st->mu);
       long my_epoch = st->barrier_epoch[name];
       if (++st->barrier_counts[name] >= count) {
@@ -161,6 +168,16 @@ void handle_connection(ServerState* st, int fd) {
       } else {
         out << "OK " << st->kv[key] << "\n";
       }
+    } else if (cmd == "TRYGET") {
+      // Non-blocking probe: MISS when absent (poll paths must not park).
+      std::string key;
+      in >> key;
+      std::unique_lock<std::mutex> lk(st->mu);
+      if (st->kv.count(key) > 0) {
+        out << "OK " << st->kv[key] << "\n";
+      } else {
+        out << "MISS\n";
+      }
     } else if (cmd == "RESIZE") {
       long new_size = 0;
       in >> new_size;
@@ -184,6 +201,14 @@ void handle_connection(ServerState* st, int fd) {
     }
     if (!send_all(fd, out.str())) break;
   }
+  {
+    // After this block the handler must not touch *st: once
+    // active_conns hits zero, server stop may free it.
+    std::lock_guard<std::mutex> lk(st->mu);
+    st->conn_fds.erase(fd);
+    st->active_conns--;
+    st->cv.notify_all();
+  }
   ::close(fd);
 }
 
@@ -199,8 +224,15 @@ void accept_loop(ServerState* st) {
     }
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    std::lock_guard<std::mutex> lk(st->mu);
-    st->conn_threads.emplace_back(handle_connection, st, fd);
+    {
+      std::lock_guard<std::mutex> lk(st->mu);
+      st->conn_fds.insert(fd);
+      st->active_conns++;
+    }
+    // Detached: handlers are reaped via the active_conns count, not
+    // join, so a long-lived coordinator serving many short-lived
+    // clients does not accumulate joinable thread carcasses.
+    std::thread(handle_connection, st, fd).detach();
   }
 }
 
@@ -261,19 +293,21 @@ void kfcoord_server_stop(void* handle) {
   if (st == nullptr) return;
   st->stopping.store(true);
   {
+    // Wake cv-waiters AND connection threads parked in recv(): shutdown
+    // on each open fd makes their recv return 0 so they observe
+    // `stopping` and exit -- without this, stop() deadlocks joining a
+    // thread that is blocked reading from a still-connected client.
     std::lock_guard<std::mutex> lk(st->mu);
     st->cv.notify_all();
+    for (int fd : st->conn_fds) ::shutdown(fd, SHUT_RDWR);
   }
   ::shutdown(st->listen_fd, SHUT_RDWR);
   ::close(st->listen_fd);
   if (st->accept_thread.joinable()) st->accept_thread.join();
-  std::vector<std::thread> conns;
   {
-    std::lock_guard<std::mutex> lk(st->mu);
-    conns.swap(st->conn_threads);
-  }
-  for (auto& t : conns) {
-    if (t.joinable()) t.join();
+    // Wait for detached handlers to drain before freeing the state.
+    std::unique_lock<std::mutex> lk(st->mu);
+    st->cv.wait(lk, [&] { return st->active_conns == 0; });
   }
   delete st;
 }
@@ -377,6 +411,21 @@ int kfcoord_kv_get(void* client, const char* key, char* buf, int buf_len) {
   auto* c = static_cast<ClientState*>(client);
   std::string resp;
   if (!client_rpc(c, std::string("GET ") + key + "\n", &resp)) return -1;
+  if (resp.rfind("OK ", 0) != 0) return -1;
+  std::string value = resp.substr(3);
+  if (static_cast<int>(value.size()) + 1 > buf_len) return -2;
+  std::memcpy(buf, value.c_str(), value.size() + 1);
+  return static_cast<int>(value.size());
+}
+
+// Non-blocking probe. Returns value length (>= 0) on hit, -3 on miss,
+// -1 on error, -2 if the buffer is too small.
+int kfcoord_kv_tryget(void* client, const char* key, char* buf,
+                      int buf_len) {
+  auto* c = static_cast<ClientState*>(client);
+  std::string resp;
+  if (!client_rpc(c, std::string("TRYGET ") + key + "\n", &resp)) return -1;
+  if (resp.rfind("MISS", 0) == 0) return -3;
   if (resp.rfind("OK ", 0) != 0) return -1;
   std::string value = resp.substr(3);
   if (static_cast<int>(value.size()) + 1 > buf_len) return -2;

@@ -1,0 +1,133 @@
+"""Elastic scaling, adaptive batch, and noise-scale tests (the KungFu
+north-star capabilities, SURVEY 2.9/5.3: resize_cluster + adaptive batch
+size driven by monitored gradient noise scale)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kf_benchmarks_tpu import benchmark, elastic, params as params_lib
+
+
+def _make_bench(**overrides):
+  defaults = dict(model="trivial", batch_size=4, num_batches=12,
+                  num_warmup_batches=1, device="cpu", num_devices=2,
+                  variable_update="kungfu", optimizer="momentum",
+                  display_every=100)
+  defaults.update(overrides)
+  p = params_lib.make_params(**defaults)
+  return benchmark.BenchmarkCNN(p)
+
+
+def test_noise_scale_metrics_reported():
+  bench = _make_bench(track_grad_noise_scale=True, num_batches=6)
+  stats = bench.run()
+  assert stats["grad_noise_scale"] is not None
+  assert np.isfinite(stats["grad_noise_scale"])
+  assert stats["grad_noise_scale"] >= 0
+
+
+def test_noise_scale_stats_math():
+  """With identical gradients on every replica the noise term vanishes;
+  g2 then equals the squared gradient norm."""
+  mesh_devices = jax.devices()[:4]
+  from jax.sharding import Mesh, PartitionSpec as P
+  mesh = Mesh(np.asarray(mesh_devices), ("replica",))
+
+  def body(g):
+    g2, s = elastic.noise_scale_stats({"w": g}, "replica",
+                                      batch_size_per_replica=8)
+    return g2, s
+
+  fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("replica"),
+                             out_specs=P()))
+  same = jnp.ones((4, 3))  # every replica holds [1,1,1]
+  g2, s = fn(same)
+  assert abs(float(g2) - 3.0) < 1e-5
+  assert abs(float(s)) < 1e-4
+
+
+def test_ema_and_b_simple():
+  ema = elastic.NoiseScaleEMA(decay=0.5)
+  assert ema.b_simple is None
+  ema.update(2.0, 8.0)
+  assert ema.b_simple == pytest.approx(4.0)
+  ema.update(2.0, 16.0)   # s_ema = 12, g2_ema = 2
+  assert ema.b_simple == pytest.approx(6.0)
+  ema.update(float("nan"), 1.0)  # non-finite samples are dropped
+  assert ema.b_simple == pytest.approx(6.0)
+
+
+def test_adaptive_policy_hysteresis():
+  policy = elastic.AdaptiveBatchPolicy(min_batch=2, max_batch=64)
+  # No estimate -> no change.
+  assert policy.propose(8, None, 2) == 8
+  # Big noise scale -> grow, one octave at a time.
+  assert policy.propose(8, 512.0, 2) == 16
+  # Small noise scale -> shrink.
+  assert policy.propose(8, 4.0, 2) == 4
+  # Within 2x -> hold (hysteresis).
+  assert policy.propose(8, 20.0, 2) == 8
+  # Bounds respected.
+  assert policy.propose(2, 0.5, 2) == 2
+  assert policy.propose(64, 1e9, 2) == 64
+
+
+def test_scheduled_resize_mid_run():
+  """Grow 2 -> 4 devices mid-run via the scheduled controller: state
+  carries across (step count keeps increasing, loss stays finite) and
+  the topology actually changes."""
+  bench = _make_bench(num_batches=12, elastic_check_every_n_steps=4)
+  bench.elastic_controller = elastic.ScheduledController({4: 4})
+  stats = bench.run()
+  assert bench.num_devices == 4
+  assert len(stats["reshape_events"]) == 1
+  assert stats["reshape_events"][0]["num_devices"] == 4
+  assert stats["num_steps"] == 12
+  assert np.isfinite(stats["last_average_loss"])
+
+
+def test_scheduled_shrink_mid_run():
+  bench = _make_bench(num_batches=10, num_devices=4,
+                      elastic_check_every_n_steps=5)
+  bench.elastic_controller = elastic.ScheduledController({5: 2})
+  stats = bench.run()
+  assert bench.num_devices == 2
+  assert len(stats["reshape_events"]) == 1
+  assert np.isfinite(stats["last_average_loss"])
+
+
+def test_resize_preserves_training_state():
+  """The restored state continues from the same global step and keeps
+  learned parameters (checkpointed rescale, SURVEY 7.4)."""
+  bench = _make_bench(num_batches=8, elastic_check_every_n_steps=4,
+                      tf_random_seed=7)
+  bench.elastic_controller = elastic.ScheduledController({4: 4})
+  stats = bench.run()
+  state = stats["state"]
+  # 1 warmup + 8 timed steps were applied in total.
+  assert int(state.step) == 9
+
+
+def test_adaptive_batch_changes_batch_size():
+  """Force a grow decision by injecting a large-noise EMA through a tiny
+  min/max window, then check the reshape event fires."""
+  bench = _make_bench(num_batches=8, adaptive_batch_size=True,
+                      adaptive_batch_min=2, adaptive_batch_max=64,
+                      elastic_check_every_n_steps=4)
+
+  class _BigNoise(elastic.NoiseScaleEMA):
+    @property
+    def b_simple(self):
+      return 4096.0
+
+  orig = elastic.NoiseScaleEMA
+  elastic.NoiseScaleEMA = _BigNoise
+  try:
+    stats = bench.run()
+  finally:
+    elastic.NoiseScaleEMA = orig
+  assert stats["reshape_events"], "expected an adaptive-batch reshape"
+  assert stats["reshape_events"][0]["batch_size_per_device"] == 8
+  assert bench.batch_size_per_device == 8  # grew 4 -> 8 (one octave)
