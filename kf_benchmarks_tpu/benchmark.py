@@ -21,6 +21,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from kf_benchmarks_tpu import checkpoint
 from kf_benchmarks_tpu import elastic as elastic_lib
 from kf_benchmarks_tpu import learning_rate
+from kf_benchmarks_tpu import observability
 from kf_benchmarks_tpu import optimizers
 from kf_benchmarks_tpu import train_step as train_step_lib
 from kf_benchmarks_tpu import validation
@@ -325,6 +326,28 @@ class BenchmarkCNN:
 
     run_step = make_run_step(train_step, eval_step)
 
+    # Observability wiring (SURVEY 5.1/5.5; see observability.py).
+    bench_logger = None
+    if p.benchmark_log_dir:
+      bench_logger = observability.BenchmarkLogger(p.benchmark_log_dir)
+      bench_logger.log_run_info(p, self.model.get_name(),
+                                self.dataset.name, self.num_devices,
+                                self.batch_size)
+    summary_writer = None
+    if p.train_dir and p.save_summaries_steps and p.summary_verbosity:
+      summary_writer = observability.SummaryWriter(p.train_dir,
+                                                   p.summary_verbosity)
+    if not p.forward_only and (p.graph_file or p.tfprof_file):
+      # One lowering feeds both dumps (tracing a big model twice is
+      # minutes of redundant startup work).
+      lowered = train_step.lower(state, images, labels)
+      if p.graph_file:
+        observability.dump_program_text(lowered, p.graph_file)
+        log_fn(f"Wrote program text to {p.graph_file}")
+      if p.tfprof_file:
+        observability.dump_cost_analysis(lowered, p.tfprof_file)
+        log_fn(f"Wrote cost analysis to {p.tfprof_file}")
+
     # Elastic / adaptive-batch drivers (north-star KungFu capabilities;
     # see elastic.py).
     noise_ema = (elastic_lib.NoiseScaleEMA()
@@ -368,14 +391,33 @@ class BenchmarkCNN:
     loop_start = time.time()
     for i in range(self.num_batches):
       t0 = time.time()
-      state, metrics = run_step(state, images, labels)
-      loss = float(metrics[p.loss_type_to_report])  # sync point, as sess.run
+      with observability.maybe_trace_step(p.trace_file, i):
+        state, metrics = run_step(state, images, labels)
+        loss = float(metrics[p.loss_type_to_report])  # sync, as sess.run
       images, labels = next_batch()
       step_train_times.append(time.time() - t0)
       images_processed += self.batch_size * max(self.num_workers, 1)
       if noise_ema is not None and "noise_scale_g2" in metrics:
         noise_ema.update(float(metrics["noise_scale_g2"]),
                          float(metrics["noise_scale_s"]))
+      if bench_logger is not None and (
+          (i + 1) % self.display_every == 0 or (i + 1) == self.num_batches):
+        # Per-step metric emission (ref: benchmark_cnn.py:847-854).
+        bench_logger.log_metric(
+            "current_examples_per_sec",
+            self.batch_size * max(self.num_workers, 1) /
+            max(step_train_times[-1], 1e-9),
+            unit="examples/sec", global_step=i + 1)
+        bench_logger.log_metric(p.loss_type_to_report, loss,
+                                global_step=i + 1)
+      if summary_writer is not None and \
+          (i + 1) % p.save_summaries_steps == 0:
+        scalars = {k: v for k, v in metrics.items()
+                   if np.ndim(v) == 0}
+        summary_writer.write_scalars(i + 1, scalars)
+        if summary_writer.verbosity >= 2:  # slice only when it will be used
+          summary_writer.write_histograms(
+              i + 1, jax.tree.map(lambda x: x[0], state.params), "params")
       if (i + 1) % self.display_every == 0 or (i + 1) == self.num_batches:
         top1 = (float(metrics["top_1_accuracy"])
                 if "top_1_accuracy" in metrics else None)
@@ -451,6 +493,11 @@ class BenchmarkCNN:
     log_fn("-" * 64)
     log_fn("total images/sec: %.2f" % images_per_sec)
     log_fn("-" * 64)
+    if bench_logger is not None:
+      # Final throughput metrics (ref: _log_benchmark_run
+      # average_examples_per_sec emission).
+      bench_logger.log_metric("average_examples_per_sec", images_per_sec,
+                              unit="examples/sec", global_step=num_steps)
     # Final checkpoint (ref: benchmark_cnn.py:2374-2378).
     if p.train_dir:
       checkpoint.save_checkpoint(p.train_dir, state, p.max_ckpts_to_keep)
@@ -486,9 +533,19 @@ class BenchmarkCNN:
     top1, top5 = top1_sum / num_eval, top5_sum / num_eval
     log_fn("Accuracy @ 1 = %.4f Accuracy @ 5 = %.4f [%d examples]" %
            (top1, top5, num_eval * self.batch_size))
+    eval_ips = num_eval * self.batch_size / max(elapsed, 1e-9)
+    if p.benchmark_log_dir:
+      # Eval-result emission (ref: benchmark_cnn.py:1915-1922). The
+      # state's step is the restored checkpoint's global step, so
+      # successive poll-loop evals stay distinguishable in metric.log.
+      gs = int(state.step)
+      logger = observability.BenchmarkLogger(p.benchmark_log_dir)
+      logger.log_metric("eval_top_1_accuracy", top1, global_step=gs)
+      logger.log_metric("eval_top_5_accuracy", top5, global_step=gs)
+      logger.log_metric("eval_images_per_sec", eval_ips,
+                        unit="examples/sec", global_step=gs)
     return {"top_1_accuracy": top1, "top_5_accuracy": top5,
-            "eval_images_per_sec":
-            num_eval * self.batch_size / max(elapsed, 1e-9)}
+            "eval_images_per_sec": eval_ips}
 
   def _run_eval(self) -> Dict[str, Any]:
     """Evaluation driver (ref: benchmark_cnn.py:1757-1794).

@@ -1,0 +1,212 @@
+"""Tracing, profiling, program dumps, summaries, and the benchmark logger.
+
+TPU-native re-design of the reference's observability stack (SURVEY 5.1,
+5.5):
+
+  --trace_file    Chrome-trace of one step (ref: benchmark_cnn.py:270-275,
+                  :806-817 RunMetadata/timeline) -> jax.profiler trace of
+                  one designated step; output readable by Perfetto /
+                  TensorBoard.
+  --tfprof_file   tfprof top-op profile (ref :276-289, :1208-1228) ->
+                  compiled-HLO cost analysis (flops / bytes accessed /
+                  estimated seconds) plus memory analysis of the jitted
+                  step.
+  --graph_file    GraphDef text dump (ref :2142-2148) -> StableHLO text of
+                  the lowered step program; the partitioned-graph analog
+                  (ref :293-296) is covered because the SPMD partitioner
+                  output is part of the compiled HLO.
+  --benchmark_log_dir  model-garden BenchmarkFileLogger JSON emission
+                  (ref :1594-1608, :847-854, :1694-1724): benchmark_run.log
+                  with run metadata + metric.log with one JSON line per
+                  metric.
+  --summary_verbosity / --save_summaries_steps  TF-summary tiers 0-3
+                  (ref :586-593, :2811-2846) -> JSONL scalar/histogram
+                  event stream under train_dir (no TensorBoard dependency;
+                  the format is trivially convertible).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+# -- one-step trace (ref: benchmark_cnn.py:270-275) -------------------------
+
+@contextlib.contextmanager
+def maybe_trace_step(trace_file: Optional[str], step: int,
+                     trace_at_step: int = 0):
+  """Trace exactly one designated step into the trace dir.
+
+  The reference captures a FULL_TRACE of a single step (step -2 there);
+  we trace the first timed step by default. jax.profiler writes a
+  directory; ``trace_file``'s directory component is used, mirroring the
+  reference's file-path flag shape.
+  """
+  if trace_file and step == trace_at_step:
+    trace_dir = os.path.dirname(trace_file) or "."
+    os.makedirs(trace_dir, exist_ok=True)
+    with jax.profiler.trace(trace_dir):
+      yield True
+    return
+  yield False
+
+
+# -- compiled-program dumps (ref: tfprof + graph_file) ----------------------
+
+def dump_program_text(lowered, path: str) -> None:
+  """StableHLO text of a lowered program (the GraphDef-dump analog,
+  ref: benchmark_cnn.py:2142-2148). Takes the result of ``jit.lower(...)``
+  so one lowering can feed multiple dumps."""
+  os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+  with open(path, "w") as f:
+    f.write(lowered.as_text())
+
+
+def dump_cost_analysis(lowered, path: str) -> Dict[str, Any]:
+  """Compiled-HLO cost + memory analysis (the tfprof analog,
+  ref: benchmark_cnn.py:276-289, :1208-1228 top-20 by accelerator time).
+
+  Takes the result of ``jit.lower(...)``; writes a JSON report and
+  returns it. Keys depend on the backend; flops and bytes-accessed are
+  present on CPU and TPU.
+  """
+  compiled = lowered.compile()
+  report: Dict[str, Any] = {}
+  try:
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+      cost = cost[0] if cost else {}
+    report["cost_analysis"] = {
+        k: float(v) for k, v in dict(cost or {}).items()
+        if np.isscalar(v) and np.isfinite(float(v))}
+  except Exception as e:  # backend-dependent surface
+    report["cost_analysis_error"] = str(e)
+  try:
+    mem = compiled.memory_analysis()
+    for attr in ("argument_size_in_bytes", "output_size_in_bytes",
+                 "temp_size_in_bytes", "generated_code_size_in_bytes"):
+      if hasattr(mem, attr):
+        report.setdefault("memory_analysis", {})[attr] = int(
+            getattr(mem, attr))
+  except Exception as e:
+    report["memory_analysis_error"] = str(e)
+  os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+  with open(path, "w") as f:
+    json.dump(report, f, indent=2, sort_keys=True)
+  return report
+
+
+# -- benchmark logger (ref: benchmark_cnn.py:1594-1608) ---------------------
+
+class BenchmarkLogger:
+  """model-garden BenchmarkFileLogger-compatible JSON emission.
+
+  benchmark_run.log: one JSON object of run metadata
+  (ref _log_benchmark_run :1694-1724). metric.log: one JSON line per
+  metric {name, value, unit, global_step, timestamp, extras}
+  (ref :847-854, :1915-1922).
+  """
+
+  def __init__(self, log_dir: str):
+    self.log_dir = log_dir
+    os.makedirs(log_dir, exist_ok=True)
+    self._metric_path = os.path.join(log_dir, "metric.log")
+
+  def log_run_info(self, params, model_name: str, dataset_name: str,
+                   num_devices: int, batch_size: int) -> None:
+    info = {
+        "model_name": model_name,
+        "dataset": {"name": dataset_name},
+        "machine_config": {"num_devices": num_devices,
+                           "platform": jax.devices()[0].platform},
+        "batch_size": batch_size,
+        "run_date": time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime()),
+        "run_parameters": [
+            {"name": k, "value": str(v)}
+            for k, v in sorted(params._asdict().items())
+            if v is not None],
+    }
+    with open(os.path.join(self.log_dir, "benchmark_run.log"), "w") as f:
+      json.dump(info, f, indent=2)
+
+  def log_metric(self, name: str, value, unit: Optional[str] = None,
+                 global_step: Optional[int] = None,
+                 extras: Optional[dict] = None) -> None:
+    value = float(value)
+    if not np.isfinite(value):
+      return
+    record = {
+        "name": name,
+        "value": value,
+        "unit": unit,
+        "global_step": global_step,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        # Canonical model-garden shape: a list of {name, value} objects.
+        "extras": [{"name": k, "value": str(v)}
+                   for k, v in sorted((extras or {}).items())],
+    }
+    with open(self._metric_path, "a") as f:
+      f.write(json.dumps(record) + "\n")
+
+
+# -- summary writer (ref: benchmark_cnn.py:586-593, 2811-2846) --------------
+
+class SummaryWriter:
+  """Tiered JSONL event stream under train_dir.
+
+  Tier 1: scalars (loss, lr, images/sec). Tier 2: + parameter/gradient
+  histograms. Tier 3: + per-variable detail (every leaf, not a capped
+  subset). The reference's tiers are summaries-none / scalars /
+  grad-histograms / all-histograms+images (ref :586-593).
+  """
+
+  MAX_TIER2_LEAVES = 16
+
+  def __init__(self, train_dir: str, verbosity: int):
+    self.verbosity = verbosity
+    self.path = os.path.join(train_dir, "events.jsonl")
+    os.makedirs(train_dir, exist_ok=True)
+
+  def _write(self, record: dict) -> None:
+    with open(self.path, "a") as f:
+      f.write(json.dumps(record) + "\n")
+
+  def write_scalars(self, step: int, scalars: Dict[str, Any]) -> None:
+    if self.verbosity < 1:
+      return
+    clean = {}
+    for k, v in scalars.items():
+      v = float(v)
+      if np.isfinite(v):
+        clean[k] = v
+    self._write({"step": step, "scalars": clean})
+
+  def write_histograms(self, step: int, tree, prefix: str) -> None:
+    if self.verbosity < 2:
+      return
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    if self.verbosity < 3:
+      leaves = leaves[:self.MAX_TIER2_LEAVES]
+    hists = {}
+    for path, leaf in leaves:
+      # Conventional slash names ("params/conv1/kernel"), not the
+      # bracketed keystr/str rendering ("['conv1']['kernel']").
+      parts = [str(getattr(p, "key", getattr(p, "name",
+                                             getattr(p, "idx", p))))
+               for p in path]
+      name = "/".join([prefix] + parts)
+      arr = np.asarray(leaf, np.float32).ravel()
+      if arr.size == 0:
+        continue
+      counts, edges = np.histogram(arr, bins=20)
+      hists[name] = {"counts": counts.tolist(),
+                     "min": float(edges[0]), "max": float(edges[-1]),
+                     "mean": float(arr.mean()), "std": float(arr.std())}
+    self._write({"step": step, "histograms": hists})

@@ -198,7 +198,7 @@ flags.DEFINE_integer("max_ckpts_to_keep", 5,
 flags.DEFINE_string("trace_file", None,
                     "Profiler trace output path (ref :270-275; jax.profiler "
                     "trace dir on TPU).")
-flags.DEFINE_string("profile_file", None,
+flags.DEFINE_string("tfprof_file", None,
                     "Per-op profile output (ref tfprof_file :276-289; "
                     "compiled-HLO cost analysis dump on TPU).")
 flags.DEFINE_string("graph_file", None,

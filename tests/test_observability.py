@@ -1,0 +1,97 @@
+"""Observability subsystem tests (SURVEY 5.1/5.5: trace, program dumps,
+cost analysis, benchmark logger, summary tiers)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kf_benchmarks_tpu import benchmark, observability, params as params_lib
+
+
+def _run(tmp_path, **overrides):
+  defaults = dict(model="trivial", batch_size=4, num_batches=6,
+                  num_warmup_batches=1, device="cpu", num_devices=2,
+                  optimizer="momentum", display_every=2)
+  defaults.update(overrides)
+  p = params_lib.make_params(**defaults)
+  return benchmark.BenchmarkCNN(p).run()
+
+
+def test_program_text_dump(tmp_path):
+  path = str(tmp_path / "program.stablehlo")
+  _run(tmp_path, graph_file=path)
+  text = open(path).read()
+  assert "module" in text  # StableHLO module header
+  assert len(text) > 1000
+
+
+def test_cost_analysis_dump(tmp_path):
+  path = str(tmp_path / "profile.json")
+  _run(tmp_path, tfprof_file=path)
+  report = json.load(open(path))
+  assert "cost_analysis" in report or "cost_analysis_error" in report
+  if "cost_analysis" in report:
+    assert report["cost_analysis"].get("flops", 0) > 0
+
+
+def test_benchmark_logger_files(tmp_path):
+  log_dir = str(tmp_path / "bench_logs")
+  stats = _run(tmp_path, benchmark_log_dir=log_dir)
+  run_info = json.load(open(os.path.join(log_dir, "benchmark_run.log")))
+  assert run_info["model_name"] == "trivial"
+  assert run_info["machine_config"]["num_devices"] == 2
+  assert any(rp["name"] == "batch_size" for rp in
+             run_info["run_parameters"])
+  metrics = [json.loads(l) for l in
+             open(os.path.join(log_dir, "metric.log"))]
+  names = {m["name"] for m in metrics}
+  assert "current_examples_per_sec" in names
+  assert "average_examples_per_sec" in names
+  assert all(np.isfinite(m["value"]) for m in metrics)
+
+
+def test_summary_tiers(tmp_path):
+  train_dir = str(tmp_path / "train")
+  _run(tmp_path, train_dir=train_dir, save_summaries_steps=2,
+       summary_verbosity=2)
+  events = [json.loads(l) for l in
+            open(os.path.join(train_dir, "events.jsonl"))]
+  scalar_events = [e for e in events if "scalars" in e]
+  hist_events = [e for e in events if "histograms" in e]
+  assert scalar_events and hist_events
+  assert "total_loss" in scalar_events[0]["scalars"]
+  first_hist = next(iter(hist_events[0]["histograms"].values()))
+  assert sum(first_hist["counts"]) > 0
+
+
+def test_summary_verbosity_zero_writes_nothing(tmp_path):
+  train_dir = str(tmp_path / "train")
+  _run(tmp_path, train_dir=train_dir, save_summaries_steps=2,
+       summary_verbosity=0)
+  assert not os.path.exists(os.path.join(train_dir, "events.jsonl"))
+
+
+def test_trace_one_step(tmp_path):
+  trace_file = str(tmp_path / "traces" / "trace")
+  _run(tmp_path, trace_file=trace_file)
+  trace_dir = str(tmp_path / "traces")
+  # jax.profiler writes plugins/profile/<run>/*.
+  found = []
+  for root, _, files in os.walk(trace_dir):
+    found += files
+  assert found, "expected profiler output files"
+
+
+def test_eval_metrics_logged(tmp_path):
+  log_dir = str(tmp_path / "bench_logs")
+  _run(tmp_path, benchmark_log_dir=log_dir, eval=True,
+       num_eval_batches=2)
+  metrics = [json.loads(l) for l in
+             open(os.path.join(log_dir, "metric.log"))]
+  names = {m["name"] for m in metrics}
+  assert {"eval_top_1_accuracy", "eval_top_5_accuracy",
+          "eval_images_per_sec"} <= names
