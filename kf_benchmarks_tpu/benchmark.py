@@ -175,12 +175,11 @@ class BenchmarkCNN:
     # Build the global batch with the model's per-device shape scaled up.
     self.model.set_batch_size(self.batch_size_per_device)
     images, labels = self.model.get_synthetic_inputs(rng, nclass)
-    global_images = jnp.tile(images, (self.num_devices,) + (1,) *
-                             (images.ndim - 1))
-    global_labels = jnp.tile(labels, (self.num_devices,))
+    # Labels may be a pytree (e.g. SSD's (boxes, classes, num_matched)).
+    tile = lambda x: jnp.tile(x, (self.num_devices,) + (1,) * (x.ndim - 1))
     batch_sharding = mesh_lib.batch_sharding(self.mesh)
-    return (jax.device_put(global_images, batch_sharding),
-            jax.device_put(global_labels, batch_sharding))
+    put = lambda x: jax.device_put(x, batch_sharding)
+    return (put(tile(images)), jax.tree.map(lambda l: put(tile(l)), labels))
 
   def _input_iterator(self, rng, subset: str = "train"):
     """Per-step input source.
@@ -337,16 +336,20 @@ class BenchmarkCNN:
     if p.train_dir and p.save_summaries_steps and p.summary_verbosity:
       summary_writer = observability.SummaryWriter(p.train_dir,
                                                    p.summary_verbosity)
-    if not p.forward_only and (p.graph_file or p.tfprof_file):
+    if p.graph_file or p.tfprof_file:
       # One lowering feeds both dumps (tracing a big model twice is
-      # minutes of redundant startup work).
-      lowered = train_step.lower(state, images, labels)
+      # minutes of redundant startup work). Forward-only dumps the eval
+      # program it actually runs.
+      dump_fn = eval_step if p.forward_only else train_step
+      lowered = dump_fn.lower(state, images, labels)
       if p.graph_file:
         observability.dump_program_text(lowered, p.graph_file)
         log_fn(f"Wrote program text to {p.graph_file}")
       if p.tfprof_file:
         observability.dump_cost_analysis(lowered, p.tfprof_file)
-        log_fn(f"Wrote cost analysis to {p.tfprof_file}")
+        log_fn("Wrote cost analysis to %s (note: the analysis compiles "
+               "the step once ahead of the jit cache's own compile)"
+               % p.tfprof_file)
 
     # Elastic / adaptive-batch drivers (north-star KungFu capabilities;
     # see elastic.py).
@@ -371,12 +374,20 @@ class BenchmarkCNN:
 
     log_fn("Running warm up")
     t0 = time.time()
-    for _ in range(self.num_warmup_batches):
-      state, metrics = run_step(state, images, labels)
-      jax.block_until_ready(metrics["total_loss"])
+    for w in range(self.num_warmup_batches):
+      # Trace a WARMUP step (the last one) so profiler start/stop and
+      # trace serialization never pollute the timed region -- the
+      # reference traces step -2 for the same reason (ref :806-817).
+      with observability.maybe_trace_step(
+          p.trace_file, w, self.num_warmup_batches - 1):
+        state, metrics = run_step(state, images, labels)
+        jax.block_until_ready(metrics["total_loss"])
       images, labels = next_batch()
     log_fn("Warmup (compile + %d steps): %.1f s" %
            (self.num_warmup_batches, time.time() - t0))
+    # Base for globally-meaningful step numbers in metric/summary streams
+    # (resumed runs must not restart their step axis at 1).
+    start_step = int(state.step)
 
     header = "Step\tImg/sec\t" + p.loss_type_to_report
     if p.print_training_accuracy:
@@ -391,7 +402,9 @@ class BenchmarkCNN:
     loop_start = time.time()
     for i in range(self.num_batches):
       t0 = time.time()
-      with observability.maybe_trace_step(p.trace_file, i):
+      # (trace fallback: with zero warmup steps the trace runs here)
+      with observability.maybe_trace_step(
+          p.trace_file if self.num_warmup_batches == 0 else None, i):
         state, metrics = run_step(state, images, labels)
         loss = float(metrics[p.loss_type_to_report])  # sync, as sess.run
       images, labels = next_batch()
@@ -407,17 +420,18 @@ class BenchmarkCNN:
             "current_examples_per_sec",
             self.batch_size * max(self.num_workers, 1) /
             max(step_train_times[-1], 1e-9),
-            unit="examples/sec", global_step=i + 1)
+            unit="examples/sec", global_step=start_step + i + 1)
         bench_logger.log_metric(p.loss_type_to_report, loss,
-                                global_step=i + 1)
+                                global_step=start_step + i + 1)
       if summary_writer is not None and \
           (i + 1) % p.save_summaries_steps == 0:
         scalars = {k: v for k, v in metrics.items()
                    if np.ndim(v) == 0}
-        summary_writer.write_scalars(i + 1, scalars)
+        summary_writer.write_scalars(start_step + i + 1, scalars)
         if summary_writer.verbosity >= 2:  # slice only when it will be used
           summary_writer.write_histograms(
-              i + 1, jax.tree.map(lambda x: x[0], state.params), "params")
+              start_step + i + 1,
+              jax.tree.map(lambda x: x[0], state.params), "params")
       if (i + 1) % self.display_every == 0 or (i + 1) == self.num_batches:
         top1 = (float(metrics["top_1_accuracy"])
                 if "top_1_accuracy" in metrics else None)
@@ -497,7 +511,8 @@ class BenchmarkCNN:
       # Final throughput metrics (ref: _log_benchmark_run
       # average_examples_per_sec emission).
       bench_logger.log_metric("average_examples_per_sec", images_per_sec,
-                              unit="examples/sec", global_step=num_steps)
+                              unit="examples/sec",
+                              global_step=start_step + num_steps)
     # Final checkpoint (ref: benchmark_cnn.py:2374-2378).
     if p.train_dir:
       checkpoint.save_checkpoint(p.train_dir, state, p.max_ckpts_to_keep)

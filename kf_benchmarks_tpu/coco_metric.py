@@ -1,0 +1,119 @@
+"""COCO mAP computation, gated on pycocotools.
+
+Host-side metric utility (ref: scripts/tf_cnn_benchmarks/coco_metric.py:
+33-178 -- async mAP via pycocotools). pycocotools is not part of this
+image's baked dependencies, so everything degrades gracefully: without
+it (or without the annotation file) predictions pass through unchanged
+and a note is attached instead of an mAP.
+
+Non-max suppression runs here in numpy (the reference delegates NMS to
+``tf.image.non_max_suppression`` inside its accuracy_function,
+ssd_model.py:430-479).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from kf_benchmarks_tpu.models import ssd_constants
+from kf_benchmarks_tpu.utils import log as log_util
+
+
+def nms(boxes: np.ndarray, scores: np.ndarray,
+        iou_threshold: float = ssd_constants.OVERLAP_CRITERIA,
+        max_out: int = ssd_constants.MAX_NUM_EVAL_BOXES) -> List[int]:
+  """Greedy per-class NMS over ltrb boxes; returns kept indices."""
+  order = np.argsort(-scores)
+  keep: List[int] = []
+  while order.size and len(keep) < max_out:
+    i = order[0]
+    keep.append(int(i))
+    if order.size == 1:
+      break
+    rest = order[1:]
+    tl = np.maximum(boxes[i, :2], boxes[rest, :2])
+    br = np.minimum(boxes[i, 2:], boxes[rest, 2:])
+    wh = np.clip(br - tl, 0.0, None)
+    inter = wh[:, 0] * wh[:, 1]
+    area_i = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+    area_r = ((boxes[rest, 2] - boxes[rest, 0]) *
+              (boxes[rest, 3] - boxes[rest, 1]))
+    iou = inter / np.clip(area_i + area_r - inter, 1e-12, None)
+    order = rest[iou <= iou_threshold]
+  return keep
+
+
+def select_detections(pred_boxes: np.ndarray, pred_scores: np.ndarray
+                      ) -> List[Dict]:
+  """Per-class score filter + NMS; detections as COCO-style dicts with
+  normalized ltrb boxes and contiguous labels."""
+  detections = []
+  num_classes = pred_scores.shape[-1]
+  for cls in range(1, num_classes):
+    scores = pred_scores[:, cls]
+    sel = scores > ssd_constants.MIN_SCORE
+    if not np.any(sel):
+      continue
+    idx = np.nonzero(sel)[0]
+    kept = nms(pred_boxes[idx], scores[idx])
+    for k in kept:
+      i = idx[k]
+      detections.append({
+          "label": cls,
+          "score": float(scores[i]),
+          "bbox_ltrb": pred_boxes[i].tolist(),
+      })
+  detections.sort(key=lambda d: -d["score"])
+  return detections[:ssd_constants.MAX_NUM_EVAL_BOXES]
+
+
+def maybe_compute_map(results: dict, params=None) -> dict:
+  """Compute COCO mAP when possible; otherwise annotate and pass through
+  (ref: coco_metric.py compute_map; async wrapper ssd_model.py:481-539).
+
+  ``results`` carries accumulated per-image predictions under
+  'predictions': a list of {source_id, pred_boxes, pred_scores,
+  raw_shape}.
+  """
+  try:
+    from pycocotools.coco import COCO
+    from pycocotools.cocoeval import COCOeval
+  except ImportError:
+    results["coco_map_note"] = (
+        "pycocotools unavailable in this environment; mAP skipped")
+    return results
+  data_dir = getattr(params, "data_dir", None) if params else None
+  annotation_path = (os.path.join(data_dir, ssd_constants.ANNOTATION_FILE)
+                     if data_dir else None)
+  if not annotation_path or not os.path.exists(annotation_path):
+    results["coco_map_note"] = "annotation file not found; mAP skipped"
+    return results
+  predictions = results.get("predictions", [])
+  coco_gt = COCO(annotation_path)
+  detections = []
+  for p in predictions:
+    h, w = p["raw_shape"][:2]
+    for d in select_detections(np.asarray(p["pred_boxes"]),
+                               np.asarray(p["pred_scores"])):
+      ymin, xmin, ymax, xmax = d["bbox_ltrb"]
+      detections.append([
+          int(p["source_id"]),
+          xmin * w, ymin * h, (xmax - xmin) * w, (ymax - ymin) * h,
+          d["score"],
+          ssd_constants.CLASS_INV_MAP[d["label"]],
+      ])
+  if not detections:
+    results["coco_map_note"] = "no detections accumulated"
+    return results
+  coco_dt = coco_gt.loadRes(np.asarray(detections))
+  coco_eval = COCOeval(coco_gt, coco_dt, iouType="bbox")
+  coco_eval.evaluate()
+  coco_eval.accumulate()
+  coco_eval.summarize()
+  results["COCO/AP"] = float(coco_eval.stats[0])
+  results["COCO/AP50"] = float(coco_eval.stats[1])
+  log_util.log_fn("COCO mAP: %.4f" % results["COCO/AP"])
+  return results

@@ -20,6 +20,7 @@ from kf_benchmarks_tpu.models import mobilenet_v2
 from kf_benchmarks_tpu.models import nasnet_model
 from kf_benchmarks_tpu.models import overfeat_model
 from kf_benchmarks_tpu.models import resnet_model
+from kf_benchmarks_tpu.models import ssd_model
 from kf_benchmarks_tpu.models import trivial_model
 from kf_benchmarks_tpu.models import vgg_model
 
@@ -66,10 +67,17 @@ _model_name_to_cifar_model: Dict[str, Callable] = {
 }
 
 
+_model_name_to_object_detection_model: Dict[str, Callable] = {
+    "ssd300": ssd_model.create_ssd300_model,
+}
+
+
 def _get_model_map(dataset_name: Optional[str]) -> Dict[str, Callable]:
   """(ref: models/model_config.py:113-124)"""
   if dataset_name == "cifar10":
     return _model_name_to_cifar_model
+  if dataset_name == "coco":
+    return _model_name_to_object_detection_model
   if dataset_name in ("imagenet", "synthetic", None):
     return _model_name_to_imagenet_model
   raise ValueError(f"Invalid dataset name: {dataset_name}")

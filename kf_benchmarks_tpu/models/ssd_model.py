@@ -1,0 +1,242 @@
+"""SSD300 with modified ResNet-34 backbone (COCO detection).
+
+TPU-native re-design of the reference SSD model (ref:
+scripts/tf_cnn_benchmarks/models/ssd_model.py:47-552): backbone per
+:96-136 (ResNet-34 with group 3 kept at stride 1 and group 4 removed),
+extra feature layers and per-level heads per :138-221, multibox loss
+with hard negative mining per :299-384 (double-argsort rank trick kept
+-- it is jittable as-is), MLPerf LR schedule per :223-255, synthetic
+inputs per :541-552.
+
+Detection targets ride the ``labels`` slot of the training step as a
+(encoded_boxes, classes, num_matched) tuple; the step treats labels as a
+pytree, so nothing else changes. Head outputs are flattened
+location-major to agree with DefaultBoxes order (see ssd_dataloader.py's
+ordering note).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from kf_benchmarks_tpu.models import model as model_lib
+from kf_benchmarks_tpu.models import resnet_model
+from kf_benchmarks_tpu.models import ssd_constants
+from kf_benchmarks_tpu.models import ssd_dataloader
+from kf_benchmarks_tpu.models.builder import ConvNetBuilder
+
+BACKBONE_MODEL_SCOPE_NAME = "resnet34_backbone"
+
+
+class _SSDModule(nn.Module):
+  """Backbone + extra layers + multibox heads, one compact module."""
+
+  label_num: int
+  phase_train: bool
+  dtype: Any = jnp.float32
+  param_dtype: Any = jnp.float32
+
+  @nn.compact
+  def __call__(self, images):
+    cnn = ConvNetBuilder(
+        input_layer=images, phase_train=self.phase_train,
+        data_format="NHWC", dtype=self.dtype,
+        param_dtype=self.param_dtype, use_batch_norm=True,
+        batch_norm_config={"decay": ssd_constants.BATCH_NORM_DECAY,
+                           "epsilon": ssd_constants.BATCH_NORM_EPSILON,
+                           "scale": True})
+
+    # ResNet-34 backbone, SSD-modified (ref: ssd_model.py:96-136):
+    # group 3 keeps stride 1 so the 38x38 map survives; group 4 removed.
+    cnn.conv(64, 7, 7, 2, 2, mode="SAME_RESNET", use_batch_norm=True)
+    cnn.mpool(3, 3, 2, 2, mode="SAME")
+    for _ in range(3):
+      resnet_model.residual_block(cnn, 64, 1, "v1")
+    for i in range(4):
+      resnet_model.residual_block(cnn, 128, 2 if i == 0 else 1, "v1")
+    for i in range(6):
+      resnet_model.residual_block(cnn, 256, 1, "v1")
+
+    def ssd_layer(depth, k, stride, mode):
+      return cnn.conv(depth, k, k, stride, stride, mode=mode,
+                      use_batch_norm=False)
+
+    activations = [cnn.top_layer]  # 38x38x256
+    ssd_layer(256, 1, 1, "VALID")
+    activations.append(ssd_layer(512, 3, 2, "SAME"))   # 19x19
+    ssd_layer(256, 1, 1, "VALID")
+    activations.append(ssd_layer(512, 3, 2, "SAME"))   # 10x10
+    ssd_layer(128, 1, 1, "VALID")
+    activations.append(ssd_layer(256, 3, 2, "SAME"))   # 5x5
+    ssd_layer(128, 1, 1, "VALID")
+    activations.append(ssd_layer(256, 3, 1, "VALID"))  # 3x3
+    ssd_layer(128, 1, 1, "VALID")
+    activations.append(ssd_layer(256, 3, 1, "VALID"))  # 1x1
+
+    locs, confs = [], []
+    batch = images.shape[0]
+    for nd, act in zip(ssd_constants.NUM_DEFAULTS, activations):
+      # Location-major flatten: [b, s, s, nd*4] -> [b, s*s*nd, 4],
+      # matching DefaultBoxes (i, j, default) order.
+      l = cnn.conv(nd * 4, 3, 3, 1, 1, input_layer=act, activation=None,
+                   use_batch_norm=False)
+      locs.append(l.reshape(batch, -1, 4))
+      c = cnn.conv(nd * self.label_num, 3, 3, 1, 1, input_layer=act,
+                   activation=None, use_batch_norm=False)
+      confs.append(c.reshape(batch, -1, self.label_num))
+    locs = jnp.concatenate(locs, axis=1)
+    confs = jnp.concatenate(confs, axis=1)
+    # [b, NUM_SSD_BOXES, 4 + label_num], as the reference packs them
+    # (ref: ssd_model.py:213-218).
+    logits = jnp.concatenate([locs, confs], axis=2).astype(jnp.float32)
+    return logits, None
+
+
+class SSD300Model(model_lib.CNNModel):
+  """SSD300 (ref: models/ssd_model.py:47-552)."""
+
+  def __init__(self, label_num=ssd_constants.NUM_CLASSES, batch_size=32,
+               learning_rate=1e-3, backbone="resnet34", params=None):
+    super().__init__("ssd300", 300, batch_size, learning_rate,
+                     params=params)
+    if backbone != "resnet34":
+      raise ValueError(f"Unsupported backbone {backbone!r}")
+    self.label_num = label_num
+    # Checkpoint-poll eval state (ref :76-86).
+    self.eval_global_step = 0
+    self.predictions = {}
+
+  def skip_final_affine_layer(self):
+    return True
+
+  def make_module(self, nclass, phase_train, data_format="NHWC",
+                  dtype=jnp.float32, param_dtype=jnp.float32):
+    del nclass, data_format  # label_num is fixed by COCO; NHWC throughout
+    return _SSDModule(label_num=self.label_num, phase_train=phase_train,
+                      dtype=dtype, param_dtype=param_dtype)
+
+  # -- inputs ---------------------------------------------------------------
+
+  def get_input_shapes(self, subset):
+    """images + (encoded boxes, classes, num_matched) (ref :401-428)."""
+    n = self.get_batch_size()
+    return [[n, self.image_size, self.image_size, self.depth],
+            [n, ssd_constants.NUM_SSD_BOXES, 4],
+            [n, ssd_constants.NUM_SSD_BOXES],
+            [n]]
+
+  def get_input_data_types(self, subset):
+    return [jnp.float32, jnp.float32, jnp.int32, jnp.float32]
+
+  def get_synthetic_inputs(self, rng, nclass):
+    """(ref :541-552) -- random images; a plausible random target set."""
+    shapes = self.get_input_shapes("train")
+    r_img, r_cls, r_n = jax.random.split(rng, 3)
+    images = jax.random.uniform(r_img, shapes[0], jnp.float32)
+    boxes = jnp.zeros(shapes[1], jnp.float32)
+    classes = jnp.where(
+        jax.random.uniform(r_cls, shapes[2]) > 0.99,
+        jax.random.randint(r_cls, shapes[2], 1, self.label_num), 0
+    ).astype(jnp.int32)
+    num_matched = jnp.maximum(
+        jnp.sum((classes > 0).astype(jnp.float32), axis=1), 1.0)
+    del r_n
+    return images, (boxes, classes, num_matched)
+
+  # -- losses (ref :299-384) ------------------------------------------------
+
+  def loss_function(self, build_network_result, labels):
+    logits, _ = build_network_result.logits
+    pred_loc = logits[..., :4]
+    pred_label = logits[..., 4:]
+    gt_loc, gt_label, num_matched = labels
+    gt_label = gt_label.astype(jnp.int32)
+    box_loss = self._localization_loss(pred_loc, gt_loc, gt_label,
+                                       num_matched)
+    class_loss = self._classification_loss(pred_label, gt_label,
+                                           num_matched)
+    return box_loss + class_loss
+
+  def _localization_loss(self, pred_loc, gt_loc, gt_label, num_matched):
+    """Smooth-L1 over positive anchors (ref :320-347)."""
+    mask = (gt_label > 0).astype(jnp.float32)
+    diff = pred_loc - gt_loc
+    abs_diff = jnp.abs(diff)
+    huber = jnp.where(abs_diff < 1.0, 0.5 * diff * diff, abs_diff - 0.5)
+    per_anchor = jnp.sum(huber, axis=2) * mask
+    per_image = jnp.sum(per_anchor, axis=1)
+    return jnp.mean(per_image / num_matched)
+
+  def _classification_loss(self, pred_label, gt_label, num_matched):
+    """Softmax xent with 3:1 hard negative mining (ref :348-384).
+
+    The reference's double-argsort rank trick is kept: rank each
+    negative anchor by its loss, keep the top 3*num_matched.
+    """
+    logp = jax.nn.log_softmax(pred_label)
+    xent = -jnp.take_along_axis(logp, gt_label[..., None],
+                                axis=2).squeeze(-1)
+    mask = (gt_label > 0).astype(jnp.float32)
+    neg_xent = xent * (1.0 - mask)
+    order = jnp.argsort(-neg_xent, axis=1)
+    rank = jnp.argsort(order, axis=1)
+    num_negs = jnp.minimum(num_matched * ssd_constants.NEGS_PER_POSITIVE,
+                           ssd_constants.NUM_SSD_BOXES)
+    top_k_neg_mask = (rank < num_negs[:, None].astype(rank.dtype)) \
+        .astype(jnp.float32)
+    per_image = jnp.sum(xent * (mask + top_k_neg_mask), axis=1)
+    return jnp.mean(per_image / num_matched)
+
+  # -- lr schedule (ref :223-255) -------------------------------------------
+
+  def get_scaled_base_learning_rate(self, batch_size):
+    return self.learning_rate * batch_size / 32.0
+
+  def get_learning_rate(self, global_step, batch_size):
+    rescaled = self.get_scaled_base_learning_rate(batch_size)
+    step = jnp.asarray(global_step, jnp.int32)
+    lr = jnp.asarray(ssd_constants.LEARNING_RATE_SCHEDULE[0][1], jnp.float32)
+    for boundary, value in ssd_constants.LEARNING_RATE_SCHEDULE[1:]:
+      lr = jnp.where(step >= boundary, jnp.asarray(value, jnp.float32), lr)
+    return lr * (rescaled / ssd_constants.LEARNING_RATE_SCHEDULE[0][1])
+
+  # -- eval -----------------------------------------------------------------
+
+  def accuracy_function(self, build_network_result, labels):
+    """Decode predictions for COCO accumulation (ref :430-479). Detection
+    has no top-k accuracy; the mAP is computed in postprocess over the
+    accumulated predictions."""
+    logits, _ = build_network_result.logits
+    pred_loc = logits[..., :4]
+    pred_scores = jax.nn.softmax(logits[..., 4:], axis=-1)
+    anchors = ssd_dataloader._default_boxes_singleton()("xywh")
+    decoded = ssd_dataloader.decode_boxes(pred_loc, anchors)
+    # Benchmark-loop compatibility: detection reports a proxy "accuracy"
+    # of mean max-class confidence so the shared eval loop has scalars.
+    # The decoded per-box arrays are returned for callers that accumulate
+    # predictions for COCO mAP (postprocess + coco_metric); the shared
+    # jitted eval step keeps only the scalars -- full mAP accumulation
+    # needs the real-COCO eval input path (per-image source ids), which
+    # is not wired yet.
+    top_conf = jnp.max(pred_scores[..., 1:], axis=-1)
+    return {"top_1_accuracy": jnp.mean(top_conf),
+            "top_5_accuracy": jnp.mean(top_conf),
+            "pred_boxes": decoded,
+            "pred_scores": pred_scores}
+
+  def postprocess(self, results):
+    """COCO mAP over accumulated predictions when pycocotools + the
+    annotation file are available (ref :481-539 async COCO eval)."""
+    try:
+      from kf_benchmarks_tpu import coco_metric
+    except ImportError:
+      return results
+    return coco_metric.maybe_compute_map(results, self.params)
+
+
+def create_ssd300_model(params=None):
+  return SSD300Model(params=params)

@@ -141,7 +141,11 @@ class BenchmarkLogger:
                  extras: Optional[dict] = None) -> None:
     value = float(value)
     if not np.isfinite(value):
-      return
+      # A diverged run must leave a trace, not a silent gap: emit a
+      # sentinel record (null value, flagged) that stays valid JSON.
+      extras = dict(extras or {})
+      extras["non_finite"] = repr(value)
+      value = None
     record = {
         "name": name,
         "value": value,

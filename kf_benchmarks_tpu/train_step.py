@@ -223,7 +223,11 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
     }
     if params.print_training_accuracy:
       acc = model.accuracy_function(net_result, labels)
-      metrics.update({k: lax.pmean(v, REPLICA_AXIS) for k, v in acc.items()})
+      # Scalars only: detection accuracy_functions also return per-box
+      # arrays (decoded predictions), which are not replicated step
+      # metrics.
+      metrics.update({k: lax.pmean(v, REPLICA_AXIS)
+                      for k, v in acc.items() if jnp.ndim(v) == 0})
     if noise_stats is not None:
       metrics["noise_scale_g2"], metrics["noise_scale_s"] = noise_stats
 
@@ -257,7 +261,8 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
     result = BuildNetworkResult(logits=(logits, aux_logits))
     acc = model.accuracy_function(result, labels)
     loss = model.loss_function(result, labels)
-    metrics = {k: lax.pmean(v, REPLICA_AXIS) for k, v in acc.items()}
+    metrics = {k: lax.pmean(v, REPLICA_AXIS)
+               for k, v in acc.items() if jnp.ndim(v) == 0}
     # Loss included so the forward-only timed loop can print the standard
     # step line (ref forward-only mode: benchmark_cnn.py:124-126).
     metrics["base_loss"] = lax.pmean(loss, REPLICA_AXIS)

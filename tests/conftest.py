@@ -22,3 +22,7 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import jax  # noqa: E402  (must come after XLA_FLAGS is set)
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+  config.addinivalue_line("markers", "slow: long-running test")
