@@ -19,6 +19,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from kf_benchmarks_tpu.models import ssd_constants
+from kf_benchmarks_tpu.models import ssd_dataloader
 from kf_benchmarks_tpu.utils import log as log_util
 
 
@@ -34,14 +35,7 @@ def nms(boxes: np.ndarray, scores: np.ndarray,
     if order.size == 1:
       break
     rest = order[1:]
-    tl = np.maximum(boxes[i, :2], boxes[rest, :2])
-    br = np.minimum(boxes[i, 2:], boxes[rest, 2:])
-    wh = np.clip(br - tl, 0.0, None)
-    inter = wh[:, 0] * wh[:, 1]
-    area_i = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
-    area_r = ((boxes[rest, 2] - boxes[rest, 0]) *
-              (boxes[rest, 3] - boxes[rest, 1]))
-    iou = inter / np.clip(area_i + area_r - inter, 1e-12, None)
+    iou = ssd_dataloader.calc_iou_matrix(boxes[i:i + 1], boxes[rest])[0]
     order = rest[iou <= iou_threshold]
   return keep
 
@@ -92,6 +86,10 @@ def maybe_compute_map(results: dict, params=None) -> dict:
     results["coco_map_note"] = "annotation file not found; mAP skipped"
     return results
   predictions = results.get("predictions", [])
+  if not predictions:
+    # Skip before parsing the ~450k-annotation json for nothing.
+    results["coco_map_note"] = "no detections accumulated"
+    return results
   coco_gt = COCO(annotation_path)
   detections = []
   for p in predictions:

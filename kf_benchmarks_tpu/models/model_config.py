@@ -12,12 +12,14 @@ from __future__ import annotations
 from typing import Callable, Dict, Optional
 
 from kf_benchmarks_tpu.models import alexnet_model
+from kf_benchmarks_tpu.models import deepspeech
 from kf_benchmarks_tpu.models import densenet_model
 from kf_benchmarks_tpu.models import googlenet_model
 from kf_benchmarks_tpu.models import inception_model
 from kf_benchmarks_tpu.models import lenet_model
 from kf_benchmarks_tpu.models import mobilenet_v2
 from kf_benchmarks_tpu.models import nasnet_model
+from kf_benchmarks_tpu.models import official_ncf_model
 from kf_benchmarks_tpu.models import overfeat_model
 from kf_benchmarks_tpu.models import resnet_model
 from kf_benchmarks_tpu.models import ssd_model
@@ -38,6 +40,7 @@ _model_name_to_imagenet_model: Dict[str, Callable] = {
     "mobilenet": mobilenet_v2.create_mobilenet_model,
     "nasnet": nasnet_model.create_nasnet_model,
     "nasnetlarge": nasnet_model.create_nasnetlarge_model,
+    "ncf": official_ncf_model.create_ncf_model,
     "resnet50": resnet_model.create_resnet50_model,
     "resnet50_v1.5": resnet_model.create_resnet50_v15_model,
     "resnet50_v2": resnet_model.create_resnet50_v2_model,
@@ -71,6 +74,10 @@ _model_name_to_object_detection_model: Dict[str, Callable] = {
     "ssd300": ssd_model.create_ssd300_model,
 }
 
+_model_name_to_speech_model: Dict[str, Callable] = {
+    "deepspeech2": deepspeech.create_deepspeech2_model,
+}
+
 
 def _get_model_map(dataset_name: Optional[str]) -> Dict[str, Callable]:
   """(ref: models/model_config.py:113-124)"""
@@ -78,6 +85,8 @@ def _get_model_map(dataset_name: Optional[str]) -> Dict[str, Callable]:
     return _model_name_to_cifar_model
   if dataset_name == "coco":
     return _model_name_to_object_detection_model
+  if dataset_name == "librispeech":
+    return _model_name_to_speech_model
   if dataset_name in ("imagenet", "synthetic", None):
     return _model_name_to_imagenet_model
   raise ValueError(f"Invalid dataset name: {dataset_name}")

@@ -18,7 +18,7 @@ CLASS_INV_MAP = (
     43, 44, 46, 47, 48, 49, 50, 51, 52, 53, 54, 55, 56, 57, 58, 59, 60, 61,
     62, 63, 64, 65, 67, 70, 72, 73, 74, 75, 76, 77, 78, 79, 80, 81, 82, 84,
     85, 86, 87, 88, 89, 90)
-_MAP = {j: i for i, j in enumerate(CLASS_INV_MAP)}
+_MAP = {j: i for i, j in enumerate(CLASS_INV_MAP)}  # local helper
 CLASS_MAP = tuple(_MAP.get(i, -1) for i in range(max(CLASS_INV_MAP) + 1))
 
 NUM_SSD_BOXES = 8732

@@ -140,11 +140,10 @@ class SSD300Model(model_lib.CNNModel):
     boxes = jnp.zeros(shapes[1], jnp.float32)
     classes = jnp.where(
         jax.random.uniform(r_cls, shapes[2]) > 0.99,
-        jax.random.randint(r_cls, shapes[2], 1, self.label_num), 0
+        jax.random.randint(r_n, shapes[2], 1, self.label_num), 0
     ).astype(jnp.int32)
     num_matched = jnp.maximum(
         jnp.sum((classes > 0).astype(jnp.float32), axis=1), 1.0)
-    del r_n
     return images, (boxes, classes, num_matched)
 
   # -- losses (ref :299-384) ------------------------------------------------
