@@ -1,0 +1,8 @@
+"""Secondary benchmark suite: small MLP/CNN/LSTM models with per-epoch
+timing and metric upload (ref: scripts/keras_benchmarks/, SURVEY 2.8).
+
+The reference's multi-backend (TF/Theano/CNTK) Keras suite maps onto one
+backend here -- flax/optax on XLA -- with the same three models, the same
+synthetic-data generators, the same first-epoch-excluded total_time
+semantics, and a local-JSON metric sink replacing the BigQuery uploader.
+"""

@@ -1,0 +1,29 @@
+"""Synthetic input generators (ref: keras_benchmarks/data_generator.py)."""
+
+import numpy as np
+
+
+def generate_img_input_data(input_shape, num_classes=10):
+  """(ref: data_generator.py:5-22) random images + integer labels."""
+  x_train = np.random.randint(0, 255, input_shape)
+  y_train = np.random.randint(0, num_classes, (input_shape[0],))
+  return x_train, y_train
+
+
+def generate_text_input_data(input_shape, p=0.05, return_as_bool=True):
+  """(ref: data_generator.py:22-40) sparse one-hot-ish text tensors and a
+  one-hot target over the last feature dimension."""
+  x = (np.random.uniform(size=input_shape) < p)
+  y_idx = np.random.randint(0, input_shape[-1], (input_shape[0],))
+  y = np.zeros((input_shape[0], input_shape[-1]), dtype=bool)
+  y[np.arange(input_shape[0]), y_idx] = True
+  if not return_as_bool:
+    return x.astype(np.float32), y.astype(np.float32)
+  return x, y
+
+
+def to_categorical(y, num_classes):
+  """keras.utils.to_categorical analog."""
+  out = np.zeros((len(y), num_classes), np.float32)
+  out[np.arange(len(y)), np.asarray(y, np.int64)] = 1.0
+  return out

@@ -1,0 +1,51 @@
+"""CIFAR10 CNN benchmark (ref: keras_benchmarks/models/
+cifar10_cnn_benchmark.py:20-75): conv32x2/pool/dropout ->
+conv64x2/pool/dropout -> dense512 -> 10, RMSprop(1e-4), 2 epochs over
+1000 random samples."""
+
+import flax.linen as nn
+import optax
+
+from kf_benchmarks_tpu.keras_benchmarks import data_generator, fit
+from kf_benchmarks_tpu.keras_benchmarks.models import timehistory
+
+
+class _Cnn(nn.Module):
+  @nn.compact
+  def __call__(self, x):
+    x = nn.relu(nn.Conv(32, (3, 3), padding="SAME")(x))
+    x = nn.relu(nn.Conv(32, (3, 3), padding="VALID")(x))
+    x = nn.max_pool(x, (2, 2), (2, 2))
+    x = nn.Dropout(0.25, deterministic=False)(x)
+    x = nn.relu(nn.Conv(64, (3, 3), padding="SAME")(x))
+    x = nn.relu(nn.Conv(64, (3, 3), padding="VALID")(x))
+    x = nn.max_pool(x, (2, 2), (2, 2))
+    x = nn.Dropout(0.25, deterministic=False)(x)
+    x = x.reshape((x.shape[0], -1))
+    x = nn.relu(nn.Dense(512)(x))
+    x = nn.Dropout(0.5, deterministic=False)(x)
+    return nn.Dense(10)(x)
+
+
+class Cifar10CnnBenchmark:
+
+  def __init__(self):
+    self.test_name = "cifar10_cnn"
+    self.sample_type = "images"
+    self.total_time = 0
+    self.batch_size = 32
+    self.epochs = 2
+    self.num_samples = 1000
+
+  def run_benchmark(self, gpus: int = 0):
+    x_train, y_train = data_generator.generate_img_input_data(
+        (self.num_samples, 3, 32, 32), 10)
+    x_train = x_train.transpose(0, 2, 3, 1).astype("float32") / 255.0
+    y_train = data_generator.to_categorical(y_train, 10)
+
+    time_callback = timehistory.TimeHistory()
+    fit.fit(_Cnn(), x_train, y_train, batch_size=self.batch_size,
+            epochs=self.epochs, tx=optax.rmsprop(1e-4),
+            time_callback=time_callback, num_devices=max(gpus, 1))
+    self.total_time = sum(time_callback.times[1:])
+    return self.total_time

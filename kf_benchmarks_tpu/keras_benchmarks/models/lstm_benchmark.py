@@ -1,0 +1,38 @@
+"""LSTM benchmark (ref: keras_benchmarks/models/lstm_benchmark.py:18-70):
+a single 128-unit LSTM over (40, 60) text tensors -> dense softmax over
+60, RMSprop(1e-2), 2 epochs over 1000 random samples."""
+
+import flax.linen as nn
+import optax
+
+from kf_benchmarks_tpu.keras_benchmarks import data_generator, fit
+from kf_benchmarks_tpu.keras_benchmarks.models import timehistory
+
+
+class _Lstm(nn.Module):
+  @nn.compact
+  def __call__(self, x):
+    outs = nn.RNN(nn.OptimizedLSTMCell(128))(x)
+    return nn.Dense(60)(outs[:, -1, :])
+
+
+class LstmBenchmark:
+
+  def __init__(self):
+    self.test_name = "lstm"
+    self.sample_type = "text"
+    self.total_time = 0
+    self.batch_size = 128
+    self.epochs = 2
+    self.num_samples = 1000
+
+  def run_benchmark(self, gpus: int = 0):
+    x, y = data_generator.generate_text_input_data(
+        (self.num_samples, 40, 60))
+    time_callback = timehistory.TimeHistory()
+    fit.fit(_Lstm(), x.astype("float32"), y.astype("float32"),
+            batch_size=self.batch_size, epochs=self.epochs,
+            tx=optax.rmsprop(1e-2), time_callback=time_callback,
+            num_devices=max(gpus, 1))
+    self.total_time = sum(time_callback.times[1:])
+    return self.total_time

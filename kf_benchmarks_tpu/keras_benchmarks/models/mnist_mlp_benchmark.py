@@ -1,0 +1,46 @@
+"""MNIST MLP benchmark (ref: keras_benchmarks/models/mnist_mlp_benchmark.py
+:21-60): 784 -> Dense512/relu/dropout x2 -> 10, RMSprop, 2 epochs over
+1000 random samples; total_time excludes epoch 0."""
+
+import flax.linen as nn
+import optax
+
+from kf_benchmarks_tpu.keras_benchmarks import data_generator, fit
+from kf_benchmarks_tpu.keras_benchmarks.models import timehistory
+
+
+class _Mlp(nn.Module):
+  @nn.compact
+  def __call__(self, x):
+    x = nn.relu(nn.Dense(512)(x))
+    x = nn.Dropout(0.2, deterministic=False)(x)
+    x = nn.relu(nn.Dense(512)(x))
+    x = nn.Dropout(0.2, deterministic=False)(x)
+    return nn.Dense(10)(x)
+
+
+class MnistMlpBenchmark:
+
+  def __init__(self):
+    self.test_name = "mnist_mlp"
+    self.sample_type = "images"
+    self.total_time = 0
+    self.batch_size = 128
+    self.epochs = 2
+    self.num_samples = 1000
+
+  def run_benchmark(self, gpus: int = 0):
+    x_train, y_train = data_generator.generate_img_input_data(
+        (self.num_samples, 28, 28), 10)
+    x_train = (x_train.reshape(self.num_samples, 784)
+               .astype("float32") / 255.0)
+    y_train = data_generator.to_categorical(y_train, 10)
+
+    time_callback = timehistory.TimeHistory()
+    fit.fit(_Mlp(), x_train, y_train, batch_size=self.batch_size,
+            epochs=self.epochs, tx=optax.rmsprop(1e-3),
+            time_callback=time_callback, num_devices=max(gpus, 1))
+
+    # First epoch pays compilation; exclude it (ref: run loop from 1).
+    self.total_time = sum(time_callback.times[1:])
+    return self.total_time

@@ -1,0 +1,21 @@
+"""Per-epoch timing callback (ref: keras_benchmarks/models/timehistory.py)."""
+
+import time
+
+
+class TimeHistory:
+  """Records wall time per epoch; used to exclude the first (compile)
+  epoch from total_time (ref: run_benchmark total_time loops from 1)."""
+
+  def __init__(self):
+    self.times = []
+    self._start = None
+
+  def on_train_begin(self):
+    self.times = []
+
+  def on_epoch_begin(self):
+    self._start = time.time()
+
+  def on_epoch_end(self):
+    self.times.append(time.time() - self._start)

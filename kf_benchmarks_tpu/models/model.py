@@ -108,6 +108,11 @@ class _CNNModule(nn.Module):
 
   @nn.compact
   def __call__(self, images):
+    if self.data_format == "NCHW" and images.shape[-1] <= 4:
+      # Inputs arrive NHWC from the data layer; transpose into the
+      # requested compute layout (ref: CNNModel NCHW transpose,
+      # models/model.py:239-276).
+      images = jnp.transpose(images, (0, 3, 1, 2))
     cnn = builder_lib.ConvNetBuilder(
         input_layer=images,
         phase_train=self.phase_train,

@@ -241,13 +241,16 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
         rng=state.rng)
     return new_state, metrics
 
-  # check_vma=False: library-internal scans (optax ctc_loss, flax RNN)
-  # build their carries from unvarying constants, which trips the strict
-  # varying-manual-axes checker even though the program is correct.
+  # Models built on library-internal scans (optax ctc_loss, flax RNN)
+  # seed carries from unvarying constants, which trips the strict
+  # varying-manual-axes checker even though the program is correct. Those
+  # models opt out via relax_shard_map_vma; everyone else keeps the
+  # checker (it catches missing pmeans under out_specs=P()).
+  check_vma = not getattr(model, "relax_shard_map_vma", False)
   train_sharded = jax.shard_map(
       per_replica_train, mesh=mesh,
       in_specs=(state_specs, P(REPLICA_AXIS), P(REPLICA_AXIS)),
-      out_specs=(state_specs, P()), check_vma=False)
+      out_specs=(state_specs, P()), check_vma=check_vma)
 
   train_step = jax.jit(train_sharded, donate_argnums=(0,))
 
@@ -275,7 +278,7 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
   eval_sharded = jax.shard_map(
       per_replica_eval, mesh=mesh,
       in_specs=(state_specs, P(REPLICA_AXIS), P(REPLICA_AXIS)),
-      out_specs=P(), check_vma=False)
+      out_specs=P(), check_vma=check_vma)
   eval_step = jax.jit(eval_sharded)
 
   # -- broadcast-init (strategy-dependent; ref: benchmark_cnn.py:2094-2100) --

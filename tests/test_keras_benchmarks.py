@@ -1,0 +1,57 @@
+"""Secondary keras_benchmarks suite tests (ref: scripts/keras_benchmarks/,
+SURVEY 2.8)."""
+
+import json
+import os
+
+import numpy as np
+
+from kf_benchmarks_tpu.keras_benchmarks import (data_generator,
+                                                run_benchmark)
+from kf_benchmarks_tpu.keras_benchmarks.models import (
+    lstm_benchmark, mnist_mlp_benchmark, timehistory)
+
+
+def test_data_generators():
+  x, y = data_generator.generate_img_input_data((10, 28, 28), 10)
+  assert x.shape == (10, 28, 28) and y.shape == (10,)
+  assert (0 <= y).all() and (y < 10).all()
+  xt, yt = data_generator.generate_text_input_data((10, 40, 60))
+  assert xt.shape == (10, 40, 60) and yt.shape == (10, 60)
+  assert yt.sum(axis=1).tolist() == [1] * 10  # one-hot targets
+  onehot = data_generator.to_categorical([1, 0, 2], 3)
+  np.testing.assert_array_equal(
+      onehot, [[0, 1, 0], [1, 0, 0], [0, 0, 1]])
+
+
+def test_time_history():
+  th = timehistory.TimeHistory()
+  th.on_train_begin()
+  for _ in range(2):
+    th.on_epoch_begin()
+    th.on_epoch_end()
+  assert len(th.times) == 2 and all(t >= 0 for t in th.times)
+
+
+def test_mnist_mlp_benchmark_runs():
+  b = mnist_mlp_benchmark.MnistMlpBenchmark()
+  b.num_samples = 256  # keep the CI run short
+  b.run_benchmark(gpus=0)
+  assert b.total_time > 0
+
+
+def test_lstm_benchmark_runs():
+  b = lstm_benchmark.LstmBenchmark()
+  b.num_samples = 256
+  b.run_benchmark(gpus=0)
+  assert b.total_time > 0
+
+
+def test_run_benchmark_uploads_metrics(tmp_path):
+  sink = str(tmp_path / "metrics.jsonl")
+  rows = run_benchmark.run("cpu_config", sink_path=sink)
+  assert len(rows) == 3
+  logged = [json.loads(l) for l in open(sink)]
+  assert {r["test_name"] for r in logged} == {"mnist_mlp", "cifar10_cnn",
+                                              "lstm"}
+  assert all(r["backend_type"] == "jax" for r in logged)
