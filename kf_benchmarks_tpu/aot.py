@@ -1,0 +1,56 @@
+"""AOT export of the forward (serving) program -- the TensorRT-path analog.
+
+The reference's forward-only mode freezes variables into constants and
+optionally converts the graph with TensorRT for serving speed (ref:
+scripts/tf_cnn_benchmarks/benchmark_cnn.py:2405-2525 _preprocess_graph,
+--trt_mode :615-620). The XLA-native equivalent is ahead-of-time
+lowering + serialization via jax.export: the jitted eval step is
+compiled for the target platform and written as a portable artifact that
+later processes deserialize and call without retracing Python.
+
+Freezing == closing the exported function over the trained variables
+(they become constants in the serialized module), exactly the
+variables-to-constants step of the reference.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import export as jax_export
+
+
+def export_forward(model, variables, batch_size: int, path: str,
+                   nclass: int = 1001, dtype=jnp.float32) -> int:
+  """Serialize the frozen forward pass to ``path``; returns byte size.
+
+  ``variables`` (trained params + batch stats) are captured as constants
+  (the freeze step); the exported module takes only the input batch.
+  """
+  model.set_batch_size(batch_size)
+  module = model.make_module(nclass=nclass, phase_train=False,
+                             data_format="NHWC", dtype=dtype,
+                             param_dtype=jnp.float32)
+
+  def frozen_forward(images):
+    logits, _ = module.apply(variables, images)
+    return logits
+
+  image_shape = tuple(model.get_input_shapes("eval")[0])
+  spec = jax.ShapeDtypeStruct(image_shape, jnp.float32)
+  exported = jax_export.export(jax.jit(frozen_forward))(spec)
+  data = exported.serialize()
+  os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+  with open(path, "wb") as f:
+    f.write(data)
+  return len(data)
+
+
+def load_forward(path: str) -> Callable:
+  """Deserialize an exported forward program into a callable."""
+  with open(path, "rb") as f:
+    exported = jax_export.deserialize(f.read())
+  return exported.call

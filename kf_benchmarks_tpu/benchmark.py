@@ -57,6 +57,12 @@ def setup(params):
           xla_flags + " --xla_force_host_platform_device_count="
           f"{params.num_devices}").strip()
     jax.config.update("jax_platforms", "cpu")
+  # Platform pre-run hook (ref: platforms_util.initialize, called from
+  # setup at benchmark_cnn.py:3356-3395). The cluster manager also goes
+  # through the platform dispatch so vendor overrides take effect.
+  from kf_benchmarks_tpu.platforms import util as platforms_util
+  platforms_util.initialize(params)
+  platforms_util.get_cluster_manager(params)
   jax.devices()  # force backend init (ref dummy session :3383-3393)
   return params
 
@@ -324,6 +330,21 @@ class BenchmarkCNN:
       return train_step
 
     run_step = make_run_step(train_step, eval_step)
+
+    if p.forward_only and p.aot_save_path:
+      # The freeze+TRT analog (ref: _preprocess_graph :2405-2525): export
+      # the trained forward pass with weights folded in as constants.
+      from kf_benchmarks_tpu import aot
+      variables = {"params": jax.tree.map(lambda x: x[0], state.params)}
+      bs = jax.tree.map(lambda x: x[0], state.batch_stats)
+      if bs:
+        variables["batch_stats"] = bs
+      nbytes = aot.export_forward(
+          self.model, variables, self.batch_size_per_device,
+          p.aot_save_path, nclass=self.dataset.num_classes,
+          dtype=self.compute_dtype)
+      log_fn(f"Exported frozen forward program to {p.aot_save_path} "
+             f"({nbytes} bytes)")
 
     # Observability wiring (SURVEY 5.1/5.5; see observability.py).
     bench_logger = None

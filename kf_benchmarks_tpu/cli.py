@@ -35,6 +35,10 @@ def main(positional_arguments):
 
 
 def run_main():
+  # Vendor-extension point before flags materialize
+  # (ref: tf_cnn_benchmarks.py main wiring; platforms/default/util.py:28).
+  from kf_benchmarks_tpu.platforms import util as platforms_util
+  platforms_util.define_platform_params()
   flags.define_flags(aliases=params_lib.ALIASES)
   app.run(main)
 

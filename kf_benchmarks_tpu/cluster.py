@@ -1,0 +1,88 @@
+"""Cluster manager: multi-host process wiring.
+
+TPU-native re-design of the reference's gRPC cluster layer (ref:
+scripts/tf_cnn_benchmarks/cnn_util.py:201-251 BaseClusterManager /
+GrpcClusterManager; job roles benchmark_cnn.py:571-577). The reference
+starts an in-process tf.train.Server per task and blocks ps/workers in
+join_server(); under JAX the multi-host runtime is flat SPMD -- every
+process runs the same program and the coordinator wires the distributed
+backend -- so:
+
+  * worker host lists + task index map onto jax.distributed.initialize
+    (coordinator = worker 0, the reference's controller-targets-worker-0
+    convention);
+  * there are no ps/controller roles on TPU (PS capability maps to
+    sharded state, SURVEY 5.8); requesting them raises with that
+    explanation rather than silently doing the wrong thing;
+  * join_server() maps to blocking until the coordination service says
+    shutdown (the kfcoord barrier), for processes that only serve.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class BaseClusterManager:
+  """(ref: cnn_util.py:201-229)."""
+
+  def __init__(self, params):
+    worker_hosts = list(params.worker_hosts or [])
+    ps_hosts = list(params.ps_hosts or [])
+    if params.job_name in ("ps", "controller"):
+      raise ValueError(
+          f"job_name={params.job_name!r} has no TPU analog: parameter "
+          "servers map to sharded optimizer state and the controller "
+          "role to the flat SPMD program (SURVEY 5.8); run every "
+          "process as a worker.")
+    if ps_hosts:
+      raise ValueError("ps_hosts set but parameter-server processes are "
+                       "not part of the TPU design (use sharded state)")
+    self._cluster_spec = {"worker": worker_hosts}
+    self.params = params
+
+  def get_target(self) -> Optional[str]:
+    """The coordinator address (ref get_target returns the session
+    master; here: worker 0, where jax.distributed's coordinator runs)."""
+    workers = self._cluster_spec["worker"]
+    return workers[0] if workers else None
+
+  def get_cluster_spec(self) -> dict:
+    return dict(self._cluster_spec)
+
+  def num_workers(self) -> int:
+    return max(len(self._cluster_spec["worker"]), 1)
+
+  def join_server(self):
+    raise NotImplementedError
+
+
+class JaxClusterManager(BaseClusterManager):
+  """Wires this process into the multi-host JAX runtime
+  (the GrpcClusterManager analog, ref: cnn_util.py:232-251)."""
+
+  def __init__(self, params):
+    super().__init__(params)
+    self._initialized = False
+    workers = self._cluster_spec["worker"]
+    if len(workers) > 1:
+      import jax
+      jax.distributed.initialize(
+          coordinator_address=workers[0],
+          num_processes=len(workers),
+          process_id=params.task_index)
+      self._initialized = True
+
+  def join_server(self):
+    """Block until the job tears down (the ps join_server analog): wait
+    on the coordination-service exit barrier when launched under kfrun,
+    else return immediately (flat SPMD has no serve-only processes)."""
+    from kf_benchmarks_tpu.parallel import kungfu
+    kungfu.run_barrier()
+
+
+def get_cluster_manager(params) -> Optional[BaseClusterManager]:
+  """(ref: platforms/default/util.py get_cluster_manager)."""
+  if not (params.worker_hosts or params.job_name):
+    return None
+  return JaxClusterManager(params)

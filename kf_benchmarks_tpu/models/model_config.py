@@ -20,6 +20,7 @@ from kf_benchmarks_tpu.models import lenet_model
 from kf_benchmarks_tpu.models import mobilenet_v2
 from kf_benchmarks_tpu.models import nasnet_model
 from kf_benchmarks_tpu.models import official_ncf_model
+from kf_benchmarks_tpu.models import official_resnet_model
 from kf_benchmarks_tpu.models import overfeat_model
 from kf_benchmarks_tpu.models import resnet_model
 from kf_benchmarks_tpu.models import ssd_model
@@ -48,6 +49,17 @@ _model_name_to_imagenet_model: Dict[str, Callable] = {
     "resnet101_v2": resnet_model.create_resnet101_v2_model,
     "resnet152": resnet_model.create_resnet152_model,
     "resnet152_v2": resnet_model.create_resnet152_v2_model,
+    "official_resnet18": official_resnet_model.create_official_resnet18_model,
+    "official_resnet34": official_resnet_model.create_official_resnet34_model,
+    "official_resnet50": official_resnet_model.create_official_resnet50_model,
+    "official_resnet50_v2":
+        official_resnet_model.create_official_resnet50_v2_model,
+    "official_resnet101":
+        official_resnet_model.create_official_resnet101_model,
+    "official_resnet152":
+        official_resnet_model.create_official_resnet152_model,
+    "official_resnet200":
+        official_resnet_model.create_official_resnet200_model,
 }
 
 _model_name_to_cifar_model: Dict[str, Callable] = {

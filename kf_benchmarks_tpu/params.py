@@ -211,6 +211,12 @@ flags.DEFINE_integer("tf_random_seed", 1234,
                      "Graph-level random seed (ref :609-612).")
 flags.DEFINE_string("backbone_model_path", None,
                     "Warm-start backbone checkpoint (SSD; ref :613-614).")
+flags.DEFINE_string("aot_save_path", None,
+                    "Forward-only mode: serialize the frozen forward "
+                    "program (AOT compile + weights-as-constants) to this "
+                    "path -- the serving-graph/TensorRT analog "
+                    "(ref trt_mode :615-620, _preprocess_graph "
+                    ":2405-2525).")
 flags.DEFINE_boolean("use_synthetic_gpu_images", False,
                      "(parity alias; synthetic data is data_dir=None)")
 # Distributed / cluster flags (ref :570-583).

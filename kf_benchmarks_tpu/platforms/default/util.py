@@ -1,0 +1,36 @@
+"""Default platform hooks (ref:
+scripts/tf_cnn_benchmarks/platforms/default/util.py:28-72)."""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+from kf_benchmarks_tpu import cluster, flags
+
+
+def define_platform_params() -> None:
+  """Extra platform params (ref :28-33). The default platform defines
+  none; vendor platforms register theirs here -- Params rebuilds
+  automatically for late definitions (params._params_type)."""
+
+
+def get_cluster_manager(params):
+  """(ref :36-44)."""
+  return cluster.get_cluster_manager(params)
+
+
+def get_test_output_dir() -> str:
+  """Where tests write outputs (ref :50-62): TEST_TMPDIR or a fresh
+  tempdir."""
+  base = os.environ.get("TEST_TMPDIR", "")
+  if base:
+    os.makedirs(base, exist_ok=True)
+    return base
+  return tempfile.mkdtemp(prefix="kf_benchmarks_test_")
+
+
+def initialize(params) -> None:
+  """Pre-run hook (ref :65-72). The default platform has nothing to do;
+  the benchmark's own setup() handles backend init."""
+  del params

@@ -1,0 +1,23 @@
+"""Platform dispatch: vendor-extension point.
+
+The reference routes all platform-specific behavior through this module
+so vendors can swap in their own (ref:
+scripts/tf_cnn_benchmarks/platforms/util.py, which imports
+platforms.default.util and re-exports its hooks). Set the
+KF_BENCHMARKS_PLATFORM env var to a module path to substitute an
+alternative platform implementation.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+
+_platform = importlib.import_module(
+    os.environ.get("KF_BENCHMARKS_PLATFORM",
+                   "kf_benchmarks_tpu.platforms.default.util"))
+
+define_platform_params = _platform.define_platform_params
+get_cluster_manager = _platform.get_cluster_manager
+get_test_output_dir = _platform.get_test_output_dir
+initialize = _platform.initialize
