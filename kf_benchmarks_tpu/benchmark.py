@@ -31,6 +31,7 @@ from kf_benchmarks_tpu.parallel import mesh as mesh_lib
 from kf_benchmarks_tpu.parallel import strategies
 from kf_benchmarks_tpu.parallel import kungfu
 from kf_benchmarks_tpu.utils import log as log_util
+from kf_benchmarks_tpu.utils import pipeline as pipeline_lib
 
 def log_fn(msg):
   """Late-bound so tests/bench can monkey-patch log_util.log_fn."""
@@ -181,6 +182,11 @@ class BenchmarkCNN:
     # Build the global batch with the model's per-device shape scaled up.
     self.model.set_batch_size(self.batch_size_per_device)
     images, labels = self.model.get_synthetic_inputs(rng, nclass)
+    # Feed floating inputs at the compute dtype: the first model op casts
+    # anyway, and a bf16-resident batch halves the HBM read of the largest
+    # input tensor every step.
+    if jnp.issubdtype(images.dtype, jnp.floating):
+      images = images.astype(self.compute_dtype)
     # Labels may be a pytree (e.g. SSD's (boxes, classes, num_matched)).
     tile = lambda x: jnp.tile(x, (self.num_devices,) + (1,) * (x.ndim - 1))
     batch_sharding = mesh_lib.batch_sharding(self.mesh)
@@ -217,11 +223,28 @@ class BenchmarkCNN:
           shift_ratio=(kungfu.current_rank() /
                        max(kungfu.current_cluster_size(), 1)),
           num_threads=p.datasets_num_private_threads or 8)
+    host_iter = pre.minibatches(self.dataset, subset)
+    if self.compute_dtype != jnp.float32:
+      host_iter = self._cast_images(host_iter)
     feeder = device_feed.DeviceFeeder(
-        pre.minibatches(self.dataset, subset),
-        mesh_lib.batch_sharding(self.mesh))
+        host_iter, mesh_lib.batch_sharding(self.mesh))
     it = iter(feeder)
     return (lambda: next(it)), feeder.stop
+
+  def _cast_images(self, host_iter):
+    """Cast float32 host batches to the compute dtype before the H2D copy
+    (halves the transfer; the model's first op performs this cast
+    otherwise)."""
+    np_dtype = np.dtype(self.compute_dtype)
+    try:
+      for images, labels in host_iter:
+        if images.dtype == np.float32:
+          images = images.astype(np_dtype)
+        yield images, labels
+    finally:
+      close = getattr(host_iter, "close", None)
+      if close is not None:
+        close()
 
   def _model_image_shape(self):
     """(H, W, C) the model consumes, from its input spec."""
@@ -420,150 +443,141 @@ class BenchmarkCNN:
     stopped_early = False
     images_processed = 0
     last_save_time = time.time()
-    loop_start = time.time()
-    # Pipelined metric fetch: jax dispatch is async, so blocking on the
-    # CURRENT step's loss every iteration (the sess.run semantic) costs a
-    # full host<->device round-trip per step -- expensive when the chip
-    # sits behind a network tunnel. Off the sync points we block on the
-    # PREVIOUS step's metrics instead: the fetch overlaps the current
-    # step's compute and the device queue never drains. Sync points
-    # (display / eval / elastic cadence / last step) still fetch the
-    # current step directly, so every printed number is exact.
-    prev_metrics = None
-    window_start = loop_start
     last_display_len = 0
-    for i in range(self.num_batches):
-      t0 = time.time()
-      need_sync = (
-          (i + 1) % self.display_every == 0 or (i + 1) == self.num_batches
-          or (p.eval_during_training_every_n_steps and
-              (i + 1) % p.eval_during_training_every_n_steps == 0)
-          or (summary_writer is not None and
-              (i + 1) % p.save_summaries_steps == 0)
-          or ((controller is not None or batch_policy is not None) and
-              (i + 1) % p.elastic_check_every_n_steps == 0))
-      # (trace fallback: with zero warmup steps the trace runs here)
-      with observability.maybe_trace_step(
-          p.trace_file if self.num_warmup_batches == 0 else None, i):
-        state, metrics = run_step(state, images, labels)
-        if need_sync or prev_metrics is None:
-          sync_metrics = metrics
-        else:
-          sync_metrics = prev_metrics
-        loss = float(sync_metrics[p.loss_type_to_report])
-      images, labels = next_batch()
-      # Noise EMA consumes each step's sample exactly once: iteration i
-      # feeds the PREVIOUS step's (already-fetched) metrics; the last
-      # step's sample is consumed after the loop.
-      if noise_ema is not None and prev_metrics is not None and \
-          "noise_scale_g2" in prev_metrics:
-        noise_ema.update(float(prev_metrics["noise_scale_g2"]),
-                         float(prev_metrics["noise_scale_s"]))
-      prev_metrics = metrics
-      step_train_times.append(time.time() - t0)
-      images_processed += self.batch_size * max(self.num_workers, 1)
-      if (i + 1) % self.display_every == 0 or (i + 1) == self.num_batches:
-        top1 = (float(metrics["top_1_accuracy"])
-                if "top_1_accuracy" in metrics else None)
-        top5 = (float(metrics["top_5_accuracy"])
-                if "top_5_accuracy" in metrics else None)
-        # Under pipelined fetches individual step walls alternate between
-        # dispatch-only and full-sync; window wall-clock over the window's
-        # steps is the meaningful per-step time series for the line's
-        # mean/uncertainty/jitter (checkpoint/eval wall time is excluded
-        # by advancing window_start below).
+    # Lag-2 pipelined metric fetch (utils/pipeline.py): blocking on each
+    # step's metrics costs a full host<->device round trip per step
+    # (measured 389 vs ~2560 img/s behind the TPU tunnel, PERF.md).
+    # Reading each step's metrics two dispatches later keeps the device
+    # queue full, every printed number is still the exact value for its
+    # step, and the read-arrival intervals are real per-step times for
+    # the mean/uncertainty/jitter stats (ref: benchmark_cnn.py:887-902).
+    pipe = pipeline_lib.MetricsPipeline(lag=2)
+
+    def _handle(done: "pipeline_lib.CompletedStep"):
+      nonlocal loss, last_display_len
+      step_train_times.append(done.interval)
+      m = done.metrics
+      loss = float(m[p.loss_type_to_report])
+      if noise_ema is not None and "noise_scale_g2" in m:
+        noise_ema.update(float(m["noise_scale_g2"]),
+                         float(m["noise_scale_s"]))
+      i1 = done.index
+      if i1 % self.display_every == 0 or i1 == self.num_batches:
+        top1 = float(m["top_1_accuracy"]) if "top_1_accuracy" in m else None
+        top5 = float(m["top_5_accuracy"]) if "top_5_accuracy" in m else None
         window = step_train_times[last_display_len:]
-        window_avg = (time.time() - window_start) / max(len(window), 1)
         log_fn(log_util.format_step_line(
-            i + 1, self.batch_size * max(self.num_workers, 1),
-            [window_avg] * max(len(window), 1), loss, top1, top5))
+            i1, self.batch_size * max(self.num_workers, 1), window, loss,
+            top1, top5))
         if bench_logger is not None:
-          # Per-step metric emission (ref: benchmark_cnn.py:847-854),
-          # rate from the same clean window as the display line.
+          # Per-step metric emission (ref: benchmark_cnn.py:847-854).
+          window_avg = sum(window) / max(len(window), 1)
           bench_logger.log_metric(
               "current_examples_per_sec",
               self.batch_size * max(self.num_workers, 1) /
               max(window_avg, 1e-9),
-              unit="examples/sec", global_step=start_step + i + 1)
+              unit="examples/sec", global_step=start_step + i1)
           bench_logger.log_metric(p.loss_type_to_report, loss,
-                                  global_step=start_step + i + 1)
-        window_start = time.time()
+                                  global_step=start_step + i1)
         last_display_len = len(step_train_times)
-      if summary_writer is not None and \
-          (i + 1) % p.save_summaries_steps == 0:
-        # sync_metrics IS the current step here (cadence in need_sync).
-        scalars = {k: v for k, v in sync_metrics.items()
-                   if np.ndim(v) == 0}
-        summary_writer.write_scalars(start_step + i + 1, scalars)
+      if summary_writer is not None and i1 % p.save_summaries_steps == 0:
+        scalars = {k: v for k, v in m.items() if np.ndim(v) == 0}
+        summary_writer.write_scalars(start_step + i1, scalars)
         if summary_writer.verbosity >= 2:  # slice only when it will be used
+          # Histograms read the live state (may be up to `lag` steps ahead
+          # of i1 -- histogram verbosity is a debugging surface).
           summary_writer.write_histograms(
-              start_step + i + 1,
+              start_step + i1,
               jax.tree.map(lambda x: x[0], state.params), "params")
-      # Periodic checkpoint by steps (ref: benchmark_cnn.py:2304-2309) or
-      # seconds (ref: Supervisor save_model_secs, :2137). Checkpoint and
-      # mid-training-eval wall time stays out of the throughput window.
-      aux_start = time.time()
-      if p.train_dir and (
+
+    loop_start = time.time()
+    pipe.reset_clock()
+    for i in range(self.num_batches):
+      save_due = p.train_dir and (
           (p.save_model_steps and (i + 1) % p.save_model_steps == 0) or
           (p.save_model_secs and
-           time.time() - last_save_time >= p.save_model_secs)):
-        checkpoint.save_checkpoint(p.train_dir, state, p.max_ckpts_to_keep)
-        last_save_time = time.time()
-      # Mid-training eval + early stop (ref: benchmark_cnn.py:2310-2324).
-      if (p.eval_during_training_every_n_steps and
-          (i + 1) % p.eval_during_training_every_n_steps == 0):
-        acc = eval_step(state, images, labels)
-        top1 = float(acc["top_1_accuracy"])
-        log_fn("Accuracy @ 1 = %.4f Accuracy @ 5 = %.4f [%d examples]" %
-               (top1, float(acc["top_5_accuracy"]), self.batch_size))
-        if p.stop_at_top_1_accuracy and top1 >= p.stop_at_top_1_accuracy:
-          log_fn(f"Stopping early at top-1 accuracy {top1:.4f} "
-                 f">= {p.stop_at_top_1_accuracy}")
-          stopped_early = True
-          break
-      window_start += time.time() - aux_start
-      # Elastic resize / adaptive batch (north-star KungFu capabilities;
-      # SURVEY 2.9, 5.3). Polled at a fixed cadence to keep the hot loop
-      # collective-free.
-      if ((controller is not None or batch_policy is not None) and
-          (i + 1) % p.elastic_check_every_n_steps == 0 and
-          (i + 1) < self.num_batches):
-        new_n = None
-        if controller is not None:
-          poll_at = getattr(controller, "poll_at", None)
-          new_n = poll_at(i + 1) if poll_at else controller.poll()
-          if new_n == self.num_devices:
-            new_n = None
-        new_bs = None
-        if batch_policy is not None and noise_ema is not None:
-          proposed = batch_policy.propose(
-              self.batch_size_per_device, noise_ema.b_simple,
-              new_n or self.num_devices)
-          if proposed != self.batch_size_per_device:
-            new_bs = proposed
-        if new_n or new_bs:
-          event = {"step": i + 1,
-                   "num_devices": new_n or self.num_devices,
-                   "batch_size_per_device":
-                       new_bs or self.batch_size_per_device,
-                   "b_simple": noise_ema.b_simple if noise_ema else None}
-          log_fn("Elastic reshape at step %d: devices %d -> %d, "
-                 "per-device batch %d -> %d" % (
-                     i + 1, self.num_devices, event["num_devices"],
-                     self.batch_size_per_device,
-                     event["batch_size_per_device"]))
-          state, train_step, eval_step, next_batch = \
-              self._reshape_topology(state, event["num_devices"],
-                                     event["batch_size_per_device"],
-                                     init_rng)
-          run_step = make_run_step(train_step, eval_step)
-          images, labels = next_batch()
-          reshape_events.append(event)
+           time.time() - last_save_time >= p.save_model_secs))
+      eval_due = (p.eval_during_training_every_n_steps and
+                  (i + 1) % p.eval_during_training_every_n_steps == 0)
+      elastic_due = (
+          (controller is not None or batch_policy is not None) and
+          (i + 1) % p.elastic_check_every_n_steps == 0)
+      # (trace fallback: with zero warmup steps the trace runs here)
+      trace_this_step = p.trace_file and self.num_warmup_batches == 0 and \
+          i == 0
+      with observability.maybe_trace_step(
+          p.trace_file if self.num_warmup_batches == 0 else None, i):
+        state, metrics = run_step(state, images, labels)
+        if trace_this_step:
+          # Dispatch is async; the trace must span the device execution.
+          jax.block_until_ready(metrics)
+      images, labels = next_batch()
+      images_processed += self.batch_size * max(self.num_workers, 1)
+      for done in pipe.push(i + 1, metrics):
+        _handle(done)
+      if save_due or eval_due or elastic_due:
+        # Sync point: resolve everything in flight so checkpoint/eval/
+        # resize wall time stays out of the per-step timing, then exclude
+        # it from the next interval via note_aux_time.
+        for done in pipe.flush():
+          _handle(done)
+        aux_start = time.time()
+        if save_due:
+          # Periodic checkpoint by steps (ref: benchmark_cnn.py:2304-2309)
+          # or seconds (ref: Supervisor save_model_secs, :2137).
+          checkpoint.save_checkpoint(p.train_dir, state,
+                                     p.max_ckpts_to_keep)
+          last_save_time = time.time()
+        if eval_due:
+          # Mid-training eval + early stop (ref: benchmark_cnn.py:2310-2324).
+          acc = jax.device_get(eval_step(state, images, labels))
+          top1 = float(acc["top_1_accuracy"])
+          log_fn("Accuracy @ 1 = %.4f Accuracy @ 5 = %.4f [%d examples]" %
+                 (top1, float(acc["top_5_accuracy"]), self.batch_size))
+          if p.stop_at_top_1_accuracy and top1 >= p.stop_at_top_1_accuracy:
+            log_fn(f"Stopping early at top-1 accuracy {top1:.4f} "
+                   f">= {p.stop_at_top_1_accuracy}")
+            stopped_early = True
+            break
+        # Elastic resize / adaptive batch (north-star KungFu capabilities;
+        # SURVEY 2.9, 5.3). Polled at a fixed cadence to keep the hot loop
+        # collective-free.
+        if elastic_due and (i + 1) < self.num_batches:
+          new_n = None
+          if controller is not None:
+            poll_at = getattr(controller, "poll_at", None)
+            new_n = poll_at(i + 1) if poll_at else controller.poll()
+            if new_n == self.num_devices:
+              new_n = None
+          new_bs = None
+          if batch_policy is not None and noise_ema is not None:
+            proposed = batch_policy.propose(
+                self.batch_size_per_device, noise_ema.b_simple,
+                new_n or self.num_devices)
+            if proposed != self.batch_size_per_device:
+              new_bs = proposed
+          if new_n or new_bs:
+            event = {"step": i + 1,
+                     "num_devices": new_n or self.num_devices,
+                     "batch_size_per_device":
+                         new_bs or self.batch_size_per_device,
+                     "b_simple": noise_ema.b_simple if noise_ema else None}
+            log_fn("Elastic reshape at step %d: devices %d -> %d, "
+                   "per-device batch %d -> %d" % (
+                       i + 1, self.num_devices, event["num_devices"],
+                       self.batch_size_per_device,
+                       event["batch_size_per_device"]))
+            state, train_step, eval_step, next_batch = \
+                self._reshape_topology(state, event["num_devices"],
+                                       event["batch_size_per_device"],
+                                       init_rng)
+            run_step = make_run_step(train_step, eval_step)
+            images, labels = next_batch()
+            reshape_events.append(event)
+        pipe.note_aux_time(time.time() - aux_start)
+    for done in pipe.flush():
+      _handle(done)
     total_time = time.time() - loop_start
-    if noise_ema is not None and prev_metrics is not None and \
-        "noise_scale_g2" in prev_metrics:
-      noise_ema.update(float(prev_metrics["noise_scale_g2"]),
-                       float(prev_metrics["noise_scale_s"]))
     if controller is not None and controller is not self.elastic_controller:
       controller.close()
 
@@ -604,12 +618,20 @@ class BenchmarkCNN:
     num_eval = p.num_eval_batches or self.num_batches
     top1_sum = top5_sum = 0.0
     start = time.time()
+    # Same lag-2 fetch pipeline as the train loop (utils/pipeline.py).
+    pipe = pipeline_lib.MetricsPipeline(lag=2)
+    accs = []
     for i in range(num_eval):
       acc = eval_step(state, images, labels)
-      top1_sum += float(acc["top_1_accuracy"])
-      top5_sum += float(acc["top_5_accuracy"])
+      for done in pipe.push(i + 1, acc):
+        accs.append(done.metrics)
       if next_batch is not None and i + 1 < num_eval:
         images, labels = next_batch()
+    for done in pipe.flush():
+      accs.append(done.metrics)
+    for acc in accs:
+      top1_sum += float(acc["top_1_accuracy"])
+      top5_sum += float(acc["top_5_accuracy"])
     elapsed = time.time() - start
     top1, top5 = top1_sum / num_eval, top5_sum / num_eval
     log_fn("Accuracy @ 1 = %.4f Accuracy @ 5 = %.4f [%d examples]" %

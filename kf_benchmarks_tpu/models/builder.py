@@ -39,6 +39,66 @@ def _activate(x, activation: Optional[str]):
   raise KeyError(f"Invalid activation type {activation!r}")
 
 
+class CompactBatchNorm(nn.Module):
+  """Batch norm that keeps activations in the compute dtype.
+
+  flax's nn.BatchNorm upcasts the full activation tensor to float32 for
+  both the statistics and the normalize arithmetic; on TPU the resulting
+  f32 activation traffic is pure HBM cost on a benchmark that is
+  bandwidth-bound (see PERF.md). Here the statistics are still accumulated
+  in float32 -- the upcast fuses into the reduction so the tensor is read
+  once at compute precision -- but the normalize is a single per-channel
+  multiply-add in the compute dtype, which XLA fuses with the neighboring
+  ReLU/residual ops.
+
+  Variable layout matches nn.BatchNorm (params: scale/bias, batch_stats:
+  mean/var, float32) so checkpoints are interchangeable. Semantics match
+  the reference's batch norm (ref: convnet_builder.py:408-462) with
+  use_fast_variance statistics.
+  """
+  use_running_average: bool
+  momentum: float = 0.999
+  epsilon: float = 0.001
+  use_scale: bool = False
+  use_bias: bool = True
+  dtype: Any = jnp.float32
+  param_dtype: Any = jnp.float32
+
+  @nn.compact
+  def __call__(self, x):
+    feat = x.shape[-1]
+    ra_mean = self.variable("batch_stats", "mean",
+                            lambda s: jnp.zeros(s, jnp.float32), (feat,))
+    ra_var = self.variable("batch_stats", "var",
+                           lambda s: jnp.ones(s, jnp.float32), (feat,))
+    if self.use_running_average:
+      mean, var = ra_mean.value, ra_var.value
+    else:
+      axes = tuple(range(x.ndim - 1))
+      xf = x.astype(jnp.float32)
+      mean = jnp.mean(xf, axes)
+      mean2 = jnp.mean(jnp.square(xf), axes)
+      var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+      if not self.is_initializing():
+        m = self.momentum
+        ra_mean.value = m * ra_mean.value + (1 - m) * mean
+        ra_var.value = m * ra_var.value + (1 - m) * var
+    inv = jax.lax.rsqrt(var + self.epsilon)
+    scale = jnp.ones((feat,), jnp.float32)
+    if self.use_scale:
+      scale = self.param("scale", nn.initializers.ones, (feat,),
+                         self.param_dtype).astype(jnp.float32)
+    bias = jnp.zeros((feat,), jnp.float32)
+    if self.use_bias:
+      bias = self.param("bias", nn.initializers.zeros, (feat,),
+                        self.param_dtype).astype(jnp.float32)
+    # Fold (mean, inv, scale, bias) into one per-channel (a, b) pair cast
+    # once to the compute dtype: y = x * a + b.
+    a = (inv * scale).astype(self.dtype)
+    b = (bias - mean * inv * scale).astype(self.dtype)
+    return x.astype(self.dtype) * a + b
+
+
 class ConvNetBuilder:
   """Builds a ConvNet anchored at ``self.top_layer`` (ref: convnet_builder.py:29)."""
 
@@ -286,7 +346,7 @@ class ConvNetBuilder:
     scale = cfg["scale"] if scale is None else scale
     epsilon = cfg["epsilon"] if epsilon is None else epsilon
     x = self._spatial(x)
-    x = nn.BatchNorm(
+    x = CompactBatchNorm(
         use_running_average=not self.phase_train,
         momentum=decay,
         epsilon=epsilon,

@@ -1,0 +1,110 @@
+"""Lag-N pipelined metrics fetching with real per-step wall times.
+
+Round-1 measured the timed loop two ways and both were wrong in one
+direction or the other: blocking on the current step's metrics every
+iteration costs a full host<->device round-trip per step (ruinous when the
+chip sits behind a network tunnel: measured 389 img/s vs 2560 with this
+pipeline on ResNet-50/v5e), while fetching one flat window average made the
+printed uncertainty/jitter constants (always 0.0). This module gives both
+honest per-step statistics and full dispatch pipelining:
+
+* Each dispatched step's metrics enter a lag-``N`` ring; an async
+  device-to-host copy is started immediately so the transfer runs as soon
+  as the step completes on device.
+* ``N`` iterations later the value is read (by then the copy has landed, so
+  the read does not stall the dispatch queue), and the wall-clock interval
+  between consecutive reads is recorded. At steady state the loop is
+  rate-limited by step completion, so these arrival intervals ARE the real
+  per-step device times -- the pipelined analog of the reference's
+  per-sess.run timing (ref: benchmark_cnn.py:786-884 benchmark_one_step,
+  :887-902 get_perf_timing).
+* Host-side pauses that are not step work (checkpoint saves, mid-train
+  eval) are excluded from the next interval via ``note_aux_time`` -- the
+  analog of the reference keeping checkpoint time out of its step timer.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+
+
+class CompletedStep:
+  """A resolved step: its 1-based index, host metrics, and wall interval."""
+
+  __slots__ = ("index", "metrics", "interval")
+
+  def __init__(self, index: int, metrics: Dict[str, Any], interval: float):
+    self.index = index
+    self.metrics = metrics
+    self.interval = interval
+
+
+def _start_async_copy(metrics) -> None:
+  for leaf in jax.tree.leaves(metrics):
+    copy = getattr(leaf, "copy_to_host_async", None)
+    if copy is not None:
+      copy()
+
+
+class MetricsPipeline:
+  """Keeps ``lag`` steps in flight; resolves older steps without stalling.
+
+  Usage:
+    pipe = MetricsPipeline(lag=2)
+    for i in range(num_batches):
+      state, metrics = step(...)
+      for done in pipe.push(i + 1, metrics):
+        handle(done)            # done.interval is a real per-step time
+    for done in pipe.flush():
+      handle(done)
+  """
+
+  def __init__(self, lag: int = 2):
+    self.lag = max(0, lag)
+    self._ring: "collections.deque[Tuple[int, Any]]" = collections.deque()
+    self._last_time: Optional[float] = None
+    self._aux_time = 0.0
+
+  def reset_clock(self) -> None:
+    """Restart interval timing (after a drain, reshape, or loop start)."""
+    self._last_time = time.time()
+    self._aux_time = 0.0
+
+  def note_aux_time(self, seconds: float) -> None:
+    """Exclude ``seconds`` of non-step host work from the next interval."""
+    self._aux_time += max(0.0, seconds)
+
+  def _resolve(self, index: int, metrics) -> CompletedStep:
+    host = jax.device_get(metrics)
+    now = time.time()
+    if self._last_time is None:
+      self._last_time = now
+      interval = 0.0
+    else:
+      interval = max(1e-9, now - self._last_time - self._aux_time)
+    self._last_time = now
+    self._aux_time = 0.0
+    return CompletedStep(index, host, interval)
+
+  def push(self, index: int, metrics) -> List[CompletedStep]:
+    """Add a just-dispatched step; return any steps that left the ring."""
+    _start_async_copy(metrics)
+    self._ring.append((index, metrics))
+    done = []
+    while len(self._ring) > self.lag:
+      done.append(self._resolve(*self._ring.popleft()))
+    return done
+
+  def flush(self) -> List[CompletedStep]:
+    """Resolve everything in flight (end of loop or forced sync point)."""
+    done = []
+    while self._ring:
+      done.append(self._resolve(*self._ring.popleft()))
+    return done
+
+  def __len__(self) -> int:
+    return len(self._ring)
