@@ -38,6 +38,99 @@ def log_fn(msg):
   log_util.log_fn(msg)
 
 
+def compute_eval_step_set(params, global_batch_size: int,
+                          num_train_examples: int, num_batches: int,
+                          start_step: int = 0, start_examples: int = 0):
+  """Training steps after which mid-training eval runs, from the
+  epoch-based and explicit-list schedules (ref: benchmark_cnn.py:1449-1476;
+  the every-n-steps cadence is checked separately in the loop).
+
+  ``start_step``/``start_examples`` re-anchor the epoch->step mapping
+  after an elastic reshape changes the global batch size mid-run (epoch
+  boundaries are example counts, not step counts)."""
+  steps = set()
+
+  def epoch_to_step(e):
+    # Ref formula: ceil(e * examples / batch) via int arithmetic,
+    # re-anchored at the examples already consumed.
+    remaining = int(e * num_train_examples) - start_examples
+    return (start_step +
+            (remaining + global_batch_size - 1) // global_batch_size)
+
+  if params.eval_during_training_every_n_epochs:
+    n = float(params.eval_during_training_every_n_epochs)
+    num_epochs = ((start_examples +
+                   (num_batches - start_step) * global_batch_size) /
+                  max(num_train_examples, 1))
+    # The endpoint is included when the run lands exactly on an epoch
+    # boundary (the reference's exclusive np.arange silently dropped the
+    # end-of-training eval for runs of exactly k*n epochs).
+    epochs = [e for e in np.arange(n, num_epochs + 1e-9, n)
+              if e * num_train_examples > start_examples]
+    steps |= {epoch_to_step(e) for e in epochs}
+  if params.eval_during_training_at_specified_steps:
+    try:
+      steps |= set(
+          map(int, params.eval_during_training_at_specified_steps))
+    except ValueError:
+      raise validation.ParamError(
+          "eval_during_training_at_specified_steps value of "
+          f"{params.eval_during_training_at_specified_steps} cannot be "
+          "converted to a list of integers (ref :1457-1463)")
+  if params.eval_during_training_at_specified_epochs:
+    try:
+      epochs = [float(e)
+                for e in params.eval_during_training_at_specified_epochs]
+    except ValueError:
+      raise validation.ParamError(
+          "eval_during_training_at_specified_epochs value of "
+          f"{params.eval_during_training_at_specified_epochs} cannot be "
+          "converted to a list of floats (ref :1465-1476)")
+    steps |= {epoch_to_step(e) for e in epochs
+              if e * num_train_examples > start_examples}
+  return steps
+
+
+def feeder_prefetch(params) -> int:
+  """Host->device prefetch depth: the deeper of the dataset prefetch
+  buffer and --batch_group_size (the reference's input producers hand the
+  staging areas ``batch_group_size`` batches at a time,
+  ref: cnn_util.py:118-198 ImageProducer, benchmark_cnn.py:134-136)."""
+  return max(params.datasets_prefetch_buffer_size or 1,
+             params.batch_group_size or 1)
+
+
+# Flags accepted for reference-CLI parity with no TPU effect. Changing
+# them from their defaults logs a note at setup (silent acceptance of an
+# ineffective flag was a round-1 defect); flags with real consumers never
+# belong here.
+_NOOP_PARITY_FLAGS = {
+    "winograd_nonfused": (
+        True, "cuDNN autotune env knob; no TPU analog (ref :3285-3297)"),
+    "gpu_memory_frac_for_testing": (
+        0.0, "per-process GPU memory split for tests; TPU memory is not "
+        "fractionally reservable (ref :336-342)"),
+    "network_topology": (
+        0, "GPU box topology table index; the TPU mesh topology comes "
+        "from the runtime (ref constants.py:21-24)"),
+    "sparse_to_dense_grads": (
+        False, "JAX gradients are already dense (ref :518-519)"),
+    "allreduce_merge_scope": (
+        1, "ScopedAllocator merge hint; XLA schedules collectives itself "
+        "(ref :561-566)"),
+    "server_protocol": (
+        "grpc", "the coordination service speaks its own protocol "
+        "(ref :578)"),
+}
+
+
+def report_noop_parity_flags(params) -> None:
+  for name, (default, why) in _NOOP_PARITY_FLAGS.items():
+    if getattr(params, name, default) != default:
+      log_fn(f"Note: --{name} is accepted for reference-CLI parity but "
+             f"has no effect on TPU: {why}")
+
+
 def setup(params):
   """Process-level setup (ref: benchmark_cnn.py:3356-3395).
 
@@ -64,6 +157,7 @@ def setup(params):
   from kf_benchmarks_tpu.platforms import util as platforms_util
   platforms_util.initialize(params)
   platforms_util.get_cluster_manager(params)
+  report_noop_parity_flags(params)
   jax.devices()  # force backend init (ref dummy session :3383-3393)
   return params
 
@@ -101,6 +195,9 @@ class BenchmarkCNN:
     self.mesh = mesh_lib.build_mesh(self.num_devices, params.device)
     self.strategy = strategies.get_strategy(params)
     self.num_batches = self._get_num_batches()
+    self.eval_step_set = compute_eval_step_set(
+        params, self.batch_size * max(self.num_workers, 1),
+        self.dataset.num_examples_per_epoch("train"), self.num_batches)
     self.num_warmup_batches = (
         params.num_warmup_batches if params.num_warmup_batches is not None
         else 5)
@@ -227,7 +324,8 @@ class BenchmarkCNN:
     if self.compute_dtype != jnp.float32:
       host_iter = self._cast_images(host_iter)
     feeder = device_feed.DeviceFeeder(
-        host_iter, mesh_lib.batch_sharding(self.mesh))
+        host_iter, mesh_lib.batch_sharding(self.mesh),
+        prefetch=feeder_prefetch(p))
     it = iter(feeder)
     return (lambda: next(it)), feeder.stop
 
@@ -287,7 +385,8 @@ class BenchmarkCNN:
     return next_batch
 
   def _reshape_topology(self, state, num_devices: int,
-                        batch_per_device: int, init_rng):
+                        batch_per_device: int, init_rng,
+                        steps_done: int = 0, examples_done: int = 0):
     """Elastic rescale: rebuild mesh + jitted steps for a new topology and
     carry training state across via the checkpoint snapshot/restore path
     (SURVEY 7.4: XLA programs are topology-fixed, so resize == re-jit +
@@ -303,6 +402,12 @@ class BenchmarkCNN:
     self.model.set_batch_size(batch_per_device)
     self.batch_size = batch_per_device * num_devices
     self.mesh = mesh_lib.build_mesh(num_devices, self.params.device)
+    # Epoch-based eval schedules are example counts; re-anchor their
+    # step mapping to the new global batch size.
+    self.eval_step_set = compute_eval_step_set(
+        self.params, self.batch_size * max(self.num_workers, 1),
+        self.dataset.num_examples_per_epoch("train"), self.num_batches,
+        start_step=steps_done, start_examples=examples_done)
     init_state, train_step, eval_step, broadcast_init = self._build()
     next_batch = self._open_input(self._data_rng, "train")
     shape = (batch_per_device,) + self._model_image_shape()
@@ -497,8 +602,10 @@ class BenchmarkCNN:
           (p.save_model_steps and (i + 1) % p.save_model_steps == 0) or
           (p.save_model_secs and
            time.time() - last_save_time >= p.save_model_secs))
-      eval_due = (p.eval_during_training_every_n_steps and
-                  (i + 1) % p.eval_during_training_every_n_steps == 0)
+      eval_due = bool(
+          (p.eval_during_training_every_n_steps and
+           (i + 1) % p.eval_during_training_every_n_steps == 0) or
+          (i + 1) in self.eval_step_set)
       elastic_due = (
           (controller is not None or batch_policy is not None) and
           (i + 1) % p.elastic_check_every_n_steps == 0)
@@ -570,7 +677,8 @@ class BenchmarkCNN:
             state, train_step, eval_step, next_batch = \
                 self._reshape_topology(state, event["num_devices"],
                                        event["batch_size_per_device"],
-                                       init_rng)
+                                       init_rng, steps_done=i + 1,
+                                       examples_done=images_processed)
             run_step = make_run_step(train_step, eval_step)
             images, labels = next_batch()
             reshape_events.append(event)

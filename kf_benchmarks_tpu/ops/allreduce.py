@@ -167,35 +167,86 @@ def _pmean_direct(vec, axis_name):
 def _rsag(vec, axis_name, shards=1):
   """Reduce-scatter + all-gather: the bandwidth-optimal ring decomposition
   (the analog of the reference's ring builders, allreduce_legacy.py:338-360).
-  ``vec`` must be padded to a multiple of the axis size."""
+
+  ``shards`` subdivides the vector into independently-reduced chunks --
+  the reference's ``alg#shards`` ring subdivision (ref: allreduce.py:32-56
+  spec, subdiv offsets :185-219): chunked collectives let XLA overlap the
+  chunks' scatter/gather phases."""
   n = lax.axis_size(axis_name)
-  scattered = lax.psum_scatter(vec, axis_name, scatter_dimension=0,
-                               tiled=True)
-  gathered = lax.all_gather(scattered, axis_name, axis=0, tiled=True)
-  return gathered / n
+  shards = max(1, int(shards))
+  size = vec.shape[0]
+  pad = (-size) % (n * shards)
+  if pad:
+    vec = jnp.concatenate([vec, jnp.zeros((pad,), vec.dtype)])
+
+  def one(v):
+    scattered = lax.psum_scatter(v, axis_name, scatter_dimension=0,
+                                 tiled=True)
+    return lax.all_gather(scattered, axis_name, axis=0, tiled=True)
+
+  if shards > 1:
+    vec = jnp.concatenate([one(part) for part in jnp.split(vec, shards)])
+  else:
+    vec = one(vec)
+  if pad:
+    vec = vec[:size]
+  return vec / n
 
 
 def _hier(vec, axis_name, num_groups=2):
-  """Hierarchical reduction by recursive doubling: log2(n) ppermute
-  exchange rounds with XOR partners (the analog of the reference's
-  recursive halving-doubling 'nccl/rechd' and two-level HierarchicalCopy,
-  batch_allreduce.py:173-267 / allreduce_legacy.py:344-348). Low-bit
-  rounds exchange with near neighbors (intra-host ICI on a (host,chip)
-  layout) before high-bit rounds cross hosts. Requires power-of-2 axis
-  size; falls back to a direct pmean otherwise."""
-  del num_groups
+  """Two-level hierarchical reduction over ``num_groups`` contiguous
+  groups: a ring all-reduce within each group (intra-host ICI on a
+  (host,chip) device order), then a stride-``group_size`` ring across the
+  groups -- (g-1) + (num_groups-1) exchange rounds instead of a flat
+  ring's n-1 (the analog of the reference's two-group reduce ->
+  cross-group reduce -> broadcast HierarchicalCopy,
+  batch_allreduce.py:173-267, and 'nccl/rechd',
+  allreduce_legacy.py:344-348). Falls back to a direct pmean when the
+  axis does not divide evenly."""
   n = lax.axis_size(axis_name)
-  if n <= 1 or (n & (n - 1)) != 0:
+  num_groups = max(2, int(num_groups))
+  if n <= 1 or n % num_groups != 0:
     return lax.pmean(vec, axis_name)
-  bit = 1
-  while bit < n:
-    perm = [(i, i ^ bit) for i in range(n)]
-    vec = vec + lax.ppermute(vec, axis_name, perm)
-    bit <<= 1
+  gsize = n // num_groups
+
+  def ring_accumulate(v, stride, rounds, block):
+    """Accumulate values around a rotate-by-``stride`` ring confined to
+    contiguous blocks of ``block`` devices."""
+    acc, cur = v, v
+    perm = []
+    for i in range(n):
+      base = (i // block) * block
+      perm.append((i, base + (i - base + stride) % block))
+    for _ in range(rounds):
+      cur = lax.ppermute(cur, axis_name, perm)
+      acc = acc + cur
+    return acc
+
+  vec = ring_accumulate(vec, 1, gsize - 1, gsize)     # intra-group sum
+  vec = ring_accumulate(vec, gsize, num_groups - 1, n)  # cross-group sum
   return vec / n
 
 
 # -- planner ----------------------------------------------------------------
+
+def _reduce_packed(vec, spec: AllReduceSpecTuple, axis_name,
+                   compact_dtype=None):
+  """Reduce one packed vector per its spec, optionally compacted to a
+  16-bit wire format (ref: compact_gradient_transfer,
+  batch_allreduce.py:96-103 fp16 compaction)."""
+  orig_dtype = vec.dtype
+  if compact_dtype is not None and vec.dtype != compact_dtype:
+    vec = vec.astype(compact_dtype)
+  if spec.alg == "psum":
+    vec = _pmean_direct(vec, axis_name)
+  elif spec.alg == "rsag":
+    vec = _rsag(vec, axis_name, spec.shards)
+  elif spec.alg == "hier":
+    vec = _hier(vec, axis_name, max(spec.shards, 2))
+  else:
+    raise ValueError(f"Unknown alg {spec.alg!r}")
+  return vec.astype(orig_dtype)
+
 
 class CollectivePlanner:
   """Spec-driven gradient reduction with small-tensor packing.
@@ -204,12 +255,24 @@ class CollectivePlanner:
   (ref: allreduce.py:344-417, batch_allreduce.py:270-297): gradients are
   bucketed by byte size per the spec ranges, each bucket packed into one
   flat vector, and reduced with the bucket's algorithm.
+
+  ``agg_max_bytes``/``agg_max_group`` apply the small-gradient packing
+  limits within each bucket: only tensors under ``agg_max_bytes`` join
+  group packs, capped at ``agg_max_group`` tensors each; larger tensors
+  share the bucket-wide pack as before (ref: agg_small_grads_max_bytes/
+  _group threading into sum_gradients_all_reduce, allreduce.py:344-417,
+  extract_ranges :420-460). ``compact_dtype`` compacts the packed wire
+  format to 16 bits (ref: compact_gradient_transfer).
   """
 
   def __init__(self, spec_tuples: Sequence[AllReduceSpecTuple],
-               num_replicas_hint: int = 8):
+               num_replicas_hint: int = 8, agg_max_bytes: int = 0,
+               agg_max_group: Optional[int] = None, compact_dtype=None):
     self.spec_tuples = list(spec_tuples)
     self.num_replicas_hint = num_replicas_hint
+    self.agg_max_bytes = agg_max_bytes
+    self.agg_max_group = agg_max_group
+    self.compact_dtype = compact_dtype
 
   def _bucket_of(self, nbytes: int) -> int:
     for i, t in enumerate(self.spec_tuples):
@@ -227,24 +290,123 @@ class CollectivePlanner:
     reduced = [None] * len(leaves)
     for b, idxs in sorted(buckets.items()):
       spec = self.spec_tuples[b]
-      vec, meta = pack_tensors([leaves[i] for i in idxs], multiple_of=n)
-      if spec.alg == "psum":
-        vec = _pmean_direct(vec, axis_name)
-      elif spec.alg == "rsag":
-        vec = _rsag(vec, axis_name, spec.shards)
-      elif spec.alg == "hier":
-        vec = _hier(vec, axis_name, max(spec.shards, 2))
+      if self.agg_max_bytes > 0:
+        small = [i for i in idxs
+                 if leaves[i].size * leaves[i].dtype.itemsize <
+                 self.agg_max_bytes]
+        rest = [i for i in idxs if i not in small]
+        group = max(1, self.agg_max_group or len(small) or 1)
+        chunks = [small[s:s + group] for s in range(0, len(small), group)]
+        if rest:
+          chunks.append(rest)
       else:
-        raise ValueError(f"Unknown alg {spec.alg!r}")
-      for i, t in zip(idxs, unpack_tensors(vec, meta)):
-        reduced[i] = t
+        chunks = [idxs]
+      for chunk in chunks:
+        vec, meta = pack_tensors([leaves[i] for i in chunk], multiple_of=n)
+        vec = _reduce_packed(vec, spec, axis_name, self.compact_dtype)
+        for i, t in zip(chunk, unpack_tensors(vec, meta)):
+          reduced[i] = t
     return jax.tree_util.tree_unflatten(treedef, reduced)
+
+
+def pack_small_reduce(grads, axis_name, max_bytes: int, max_group: int,
+                      num_replicas: int, compact_dtype=None):
+  """Default-path (no spec) small-gradient aggregation: pack tensors
+  smaller than ``max_bytes`` into groups of at most ``max_group`` and
+  all-reduce each pack as one tensor; larger tensors reduce individually
+  (ref: agg_small_grads_max_bytes/_group, allreduce.py:420-588
+  pack_small_tensors/unpack_small_tensors)."""
+  spec = AllReduceSpecTuple(alg="psum", shards=1, limit=None)
+  leaves, treedef = jax.tree_util.tree_flatten(grads)
+  reduced = [None] * len(leaves)
+  small = [i for i, l in enumerate(leaves)
+           if l.size * l.dtype.itemsize < max_bytes]
+  for i, leaf in enumerate(leaves):
+    if i not in small:
+      reduced[i] = _reduce_packed(
+          jnp.ravel(leaf), spec, axis_name, compact_dtype).reshape(leaf.shape)
+  group = max(1, max_group)
+  for start in range(0, len(small), group):
+    chunk = small[start:start + group]
+    vec, meta = pack_tensors([leaves[i] for i in chunk],
+                             multiple_of=num_replicas)
+    vec = _reduce_packed(vec, spec, axis_name, compact_dtype)
+    for i, t in zip(chunk, unpack_tensors(vec, meta)):
+      reduced[i] = t
+  return jax.tree_util.tree_unflatten(treedef, reduced)
+
+
+def repack_reduce(grads, axis_name, num_chunks: int, num_replicas: int,
+                  compact_dtype=None):
+  """Default-path gradient repacking: concatenate ALL gradients into one
+  vector, re-split it into ``num_chunks`` even chunks, and reduce each --
+  the reference's --gradient_repacking, which re-shapes the reduction
+  granularity away from tensor boundaries so chunks pipeline
+  (ref: batch_allreduce.py:391-481 _TensorPacker)."""
+  spec = AllReduceSpecTuple(alg="psum", shards=1, limit=None)
+  leaves, treedef = jax.tree_util.tree_flatten(grads)
+  vec, meta = pack_tensors(leaves, multiple_of=num_replicas)
+  num_chunks = max(1, int(num_chunks))
+  chunk = -(-vec.shape[0] // num_chunks)
+  pad = chunk * num_chunks - vec.shape[0]
+  size = vec.shape[0]
+  if pad:
+    vec = jnp.concatenate([vec, jnp.zeros((pad,), vec.dtype)])
+  parts = [_reduce_packed(part, spec, axis_name, compact_dtype)
+           for part in jnp.split(vec, num_chunks)]
+  vec = jnp.concatenate(parts)[:size]
+  return jax.tree_util.tree_unflatten(treedef,
+                                      unpack_tensors(vec, meta))
+
+
+def hier_reduce(grads, axis_name, num_groups: int = 2, compact_dtype=None):
+  """Default-path two-level reduction (ref: --hierarchical_copy,
+  batch_allreduce.py:173-267 HierarchicalCopy): on TPU, a grouped psum
+  within contiguous device groups then across them."""
+  def one(x):
+    orig = x.dtype
+    if compact_dtype is not None and x.dtype != compact_dtype:
+      x = x.astype(compact_dtype)
+    return _hier(x, axis_name, num_groups).astype(orig)
+  return jax.tree.map(one, grads)
+
+
+def build_reducer(params):
+  """Flag-selected gradient reducer for the replicated-family strategies,
+  or None for the direct-pmean default (ref selection:
+  batch_allreduce.py:300-317 algorithm_from_params -- spec > repacking >
+  small-grad aggregation > hierarchical copy > plain copy).
+
+  Returns fn(grads, axis_name) or None. compact_gradient_transfer rides
+  every packed path when reduced precision is on (the fp16-compaction
+  analog; bf16 wire format on TPU)."""
+  compact = jnp.bfloat16 if (params.compact_gradient_transfer and
+                             params.use_fp16) else None
+  if params.all_reduce_spec:
+    return build_planner(params).reduce
+  if params.gradient_repacking:
+    return lambda g, ax: repack_reduce(
+        g, ax, params.gradient_repacking, params.num_devices, compact)
+  if params.agg_small_grads_max_bytes > 0:
+    return lambda g, ax: pack_small_reduce(
+        g, ax, params.agg_small_grads_max_bytes,
+        params.agg_small_grads_max_group, params.num_devices, compact)
+  if params.hierarchical_copy:
+    return lambda g, ax: hier_reduce(g, ax, num_groups=2,
+                                     compact_dtype=compact)
+  return None
 
 
 def build_planner(params) -> Optional[CollectivePlanner]:
   """Construct the planner from --all_reduce_spec (ref selection:
-  batch_allreduce.py:300-317 algorithm_from_params)."""
+  batch_allreduce.py:300-317 algorithm_from_params), honoring the
+  agg_small_grads group cap and 16-bit wire compaction."""
   if not params.all_reduce_spec:
     return None
   tuples = parse_all_reduce_spec(params.all_reduce_spec)
-  return CollectivePlanner(tuples, num_replicas_hint=params.num_devices)
+  compact = jnp.bfloat16 if (params.compact_gradient_transfer and
+                             params.use_fp16) else None
+  return CollectivePlanner(tuples, num_replicas_hint=params.num_devices,
+                           agg_max_bytes=params.agg_small_grads_max_bytes,
+                           agg_max_group=params.agg_small_grads_max_group,
+                           compact_dtype=compact)

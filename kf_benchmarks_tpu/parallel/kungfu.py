@@ -80,12 +80,18 @@ def allreduce_mean(tree, axis_name: str = REPLICA_AXIS):
 
 def broadcast(tree, root: int = 0, axis_name: str = REPLICA_AXIS):
   """Replica-``root`` broadcast of a pytree (ref: kungfu broadcast,
-  benchmark_cnn.py:2097-2100): mask non-root values, psum."""
+  benchmark_cnn.py:2097-2100): zero non-root values, psum.
+
+  Dtype-preserving: the masked psum runs in each leaf's own dtype (ints
+  stay ints -- routing int32 through float32 would corrupt values above
+  2^24); bools ride an int32 psum."""
   idx = lax.axis_index(axis_name)
-  mask = (idx == root).astype(jnp.float32)
 
   def bcast(x):
-    return lax.psum(x.astype(jnp.float32) * mask, axis_name).astype(x.dtype)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    if masked.dtype == jnp.bool_:
+      return lax.psum(masked.astype(jnp.int32), axis_name).astype(jnp.bool_)
+    return lax.psum(masked, axis_name)
 
   return jax.tree.map(bcast, tree)
 

@@ -76,12 +76,24 @@ class IndependentStrategy(Strategy):
 
 class ReplicatedStrategy(Strategy):
   """All-reduce averaged gradients, replicated weights
-  (ref: variable_mgr.py:277-368)."""
+  (ref: variable_mgr.py:277-368).
+
+  ``reducer`` is the flag-selected reduction path built by
+  ops/allreduce.build_reducer -- all_reduce_spec planner, gradient
+  repacking, small-grad aggregation, or hierarchical copy (ref:
+  batch_allreduce.py:300-317 algorithm_from_params); None = direct pmean.
+  """
 
   name = "replicated"
   cross_replica = True
 
+  def __init__(self, params=None, reducer=None):
+    super().__init__(params)
+    self.reducer = reducer
+
   def reduce_gradients(self, grads, axis_name=REPLICA_AXIS):
+    if self.reducer is not None:
+      return self.reducer(grads, axis_name)
     return kungfu.allreduce_mean(grads, axis_name)
 
   def sync_batch_stats(self, batch_stats, axis_name=REPLICA_AXIS):
@@ -100,14 +112,11 @@ class CollectiveAllReduceStrategy(ReplicatedStrategy):
   reduce-scatter + all-gather or hierarchical 2-level reductions."""
   name = "collective_all_reduce"
 
-  def __init__(self, params=None, planner=None):
-    super().__init__(params)
+  def __init__(self, params=None, planner=None, reducer=None):
+    if planner is not None and reducer is None:
+      reducer = planner.reduce
+    super().__init__(params, reducer=reducer)
     self.planner = planner
-
-  def reduce_gradients(self, grads, axis_name=REPLICA_AXIS):
-    if self.planner is not None:
-      return self.planner.reduce(grads, axis_name)
-    return kungfu.allreduce_mean(grads, axis_name)
 
 
 class KungFuStrategy(Strategy):
@@ -159,20 +168,19 @@ def get_strategy(params) -> Strategy:
   vu = params.variable_update
   if vu == "independent":
     return IndependentStrategy(params)
+  from kf_benchmarks_tpu.ops import allreduce
+  reducer = allreduce.build_reducer(params)
   if vu in ("replicated", "distributed_replicated"):
-    return ReplicatedStrategy(params)
+    return ReplicatedStrategy(params, reducer=reducer)
   if vu == "parameter_server":
-    return ParameterServerStrategy(params)
+    return ParameterServerStrategy(params, reducer=reducer)
   if vu in ("collective_all_reduce", "distributed_all_reduce"):
-    planner = None
-    if params.all_reduce_spec:
-      from kf_benchmarks_tpu.ops import allreduce
-      planner = allreduce.build_planner(params)
-    return CollectiveAllReduceStrategy(params, planner=planner)
+    return CollectiveAllReduceStrategy(
+        params, planner=allreduce.build_planner(params), reducer=reducer)
   if vu == "horovod":
     # Horovod's per-gradient allreduce has the same SPMD data plane as
     # replicated (ref: benchmark_cnn.py:3122-3130).
-    s = ReplicatedStrategy(params)
+    s = ReplicatedStrategy(params, reducer=reducer)
     s.name = "horovod"
     return s
   if vu == "kungfu":

@@ -25,8 +25,9 @@ flags.DEFINE_string("model", "trivial",
 flags.DEFINE_integer("batch_size", 0, "Per-device batch size (0 = model "
                      "default; ref :130-133).", lower_bound=0)
 flags.DEFINE_integer("batch_group_size", 1,
-                     "Number of batches each input producer group handles "
-                     "(ref :134-136).", lower_bound=1)
+                     "Number of batches the input feeder keeps in flight "
+                     "ahead of the step loop (ref :134-136; wired to the "
+                     "DeviceFeeder prefetch depth).", lower_bound=1)
 flags.DEFINE_integer("num_batches", None,
                      "Number of timed batches to run (ref :137-139).")
 flags.DEFINE_float("num_epochs", None,
@@ -53,6 +54,14 @@ flags.DEFINE_float("num_eval_epochs", None,
                    "Number of eval epochs (ref :156-160).")
 flags.DEFINE_integer("eval_during_training_every_n_steps", None,
                      "Mid-training eval cadence in steps (ref :161-166).")
+flags.DEFINE_float("eval_during_training_every_n_epochs", None,
+                   "Mid-training eval cadence in epochs (ref :140-143).")
+flags.DEFINE_list("eval_during_training_at_specified_steps", [],
+                  "Explicit training steps after which to run eval "
+                  "(ref :144-147).")
+flags.DEFINE_list("eval_during_training_at_specified_epochs", [],
+                  "Explicit training epochs after which to run eval "
+                  "(ref :148-152).")
 flags.DEFINE_float("stop_at_top_1_accuracy", None,
                    "Stop training early once this top-1 is reached "
                    "(ref :167-172).")
@@ -102,20 +111,24 @@ flags.DEFINE_string("all_reduce_spec", None,
                     "all-gather), hierarchical; size-ranged hybrids kept.")
 flags.DEFINE_integer("agg_small_grads_max_bytes", 0,
                      "Pack gradients smaller than this into one tensor "
-                     "(ref :554-557).")
+                     "before the all-reduce (ref :554-557; 0 = off).")
 flags.DEFINE_integer("agg_small_grads_max_group", 10,
                      "Max number of small gradients per pack (ref :558-560).")
 flags.DEFINE_integer("allreduce_merge_scope", 1,
-                     "Merge-scope chunking granularity (ref :561-566).")
+                     "Accepted for parity, no TPU effect: ScopedAllocator "
+                     "merge hint; XLA schedules collectives itself "
+                     "(ref :561-566).")
 flags.DEFINE_integer("gradient_repacking", 0,
-                     "Re-split gradient bytes into this many chunks for "
-                     "reduction (ref :499-502).", lower_bound=0)
+                     "Re-split the concatenated gradient vector into this "
+                     "many evenly-sized chunks for reduction (ref "
+                     ":499-502; 0 = off; exclusive with --all_reduce_spec).",
+                     lower_bound=0)
 flags.DEFINE_boolean("compact_gradient_transfer", True,
-                     "Compact gradients to 16-bit for the all-reduce "
-                     "(ref :503-506).")
+                     "Compact gradients to a 16-bit wire format (bf16) for "
+                     "the all-reduce when --use_fp16 is on (ref :503-506).")
 flags.DEFINE_boolean("hierarchical_copy", False,
-                     "Two-level reduction topology (ref :507-513); on TPU "
-                     "maps to a 2D (host, chip) mesh reduction.")
+                     "Two-level reduction: grouped psum within contiguous "
+                     "device groups, then across them (ref :507-513).")
 flags.DEFINE_integer("network_topology", 0,
                      "Topology hint index (ref constants.py:21-24).")
 flags.DEFINE_enum("local_parameter_device", "cpu", ("cpu", "gpu", "tpu"),
@@ -151,8 +164,9 @@ flags.DEFINE_boolean("single_l2_loss_op", False,
 flags.DEFINE_float("gradient_clip", None, "Gradient clip magnitude "
                    "(ref :412-413).")
 flags.DEFINE_boolean("use_xla_compile", True,
-                     "jit the whole step function. Always true in spirit on "
-                     "TPU; kept for parity (ref xla_compile :413-416).")
+                     "jit the whole step function. Must stay true: XLA "
+                     "compilation IS the TPU execution model; false is "
+                     "rejected in validation (ref xla_compile :413-416).")
 flags.DEFINE_boolean("sync_on_finish", False,
                      "Barrier across workers at exit (ref :567-569; KungFu "
                      "run_barrier analog, ref tf_cnn_benchmarks.py:58-60).")
@@ -249,8 +263,10 @@ flags.DEFINE_boolean("datasets_use_caching", False,
 flags.DEFINE_integer("input_preprocessing_parallelism", 16,
                      "Parallel parse/augment calls (ref map parallelism).")
 flags.DEFINE_boolean("use_datasets", True,
-                     "Use the tf.data-backed pipeline when real data is "
-                     "given (ref :215-217).")
+                     "Must stay true: the framework has one host input "
+                     "pipeline; the reference's legacy RecordInput path "
+                     "has no TPU analog and false is rejected "
+                     "(ref :215-217).")
 flags.DEFINE_enum("resize_method", "bilinear",
                   ("round_robin", "nearest", "bilinear", "bicubic", "area"),
                   "Eval/train resize method (ref :195-198).")

