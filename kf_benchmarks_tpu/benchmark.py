@@ -436,13 +436,28 @@ class BenchmarkCNN:
     # Resume from the newest checkpoint if the train_dir has one; the run
     # then executes num_batches MORE steps from the restored global step
     # (ref: Supervisor auto-restore, benchmark_cnn.py:2122-2157).
+    resumed = False
     if p.train_dir:
       try:
         path, ckpt_step = checkpoint.latest_checkpoint(p.train_dir)
         state = checkpoint.restore_state(state, checkpoint.load_checkpoint(path))
         log_fn(f"Restored checkpoint at global step {ckpt_step}")
+        resumed = True
       except checkpoint.CheckpointNotFoundException:
         pass
+    # Backbone warm-start before training (ref: benchmark_cnn.py:2204-2205
+    # load_backbone_model at session start). Skipped on resume: the
+    # resumed checkpoint's backbone is further-trained than the
+    # warm-start values, which must not overwrite it mid-trajectory.
+    if p.backbone_model_path and not resumed:
+      state, n_restored = checkpoint.restore_backbone(
+          state, p.backbone_model_path)
+      if not n_restored:
+        raise ValueError(
+            f"--backbone_model_path={p.backbone_model_path} matched no "
+            "variables of this model (wrong checkpoint?)")
+      log_fn(f"Loaded {n_restored} backbone tensors from "
+             f"{p.backbone_model_path}")
     # Replica-0 broadcast at start (ref: benchmark_cnn.py:2094-2100).
     state = state.replace(params=broadcast_init(state.params))
     jax.block_until_ready(state.params)
@@ -776,6 +791,23 @@ class BenchmarkCNN:
     state = jax.jit(init_state)(
         init_rng, jnp.zeros((self.batch_size_per_device,) + shape,
                             jnp.float32))
+    # Detection (and other accumulate-then-postprocess) models own their
+    # real-data eval: per-image prediction accumulation + mAP has no
+    # scalar top-k loop to share (ref: ssd postprocess, ssd_model.py:481-539).
+    custom_eval = getattr(self.model, "evaluate_real_data", None)
+    if custom_eval is not None and not self.dataset.use_synthetic_gpu_inputs():
+      if p.train_dir:
+        try:
+          path, _ = checkpoint.latest_checkpoint(p.train_dir)
+          state = checkpoint.restore_state(state,
+                                           checkpoint.load_checkpoint(path))
+        except checkpoint.CheckpointNotFoundException:
+          pass
+      variables = {"params": jax.tree.map(lambda x: x[0], state.params)}
+      bs = jax.tree.map(lambda x: x[0], state.batch_stats)
+      if bs:
+        variables["batch_stats"] = bs
+      return custom_eval(variables, p, self.dataset)
     if not p.train_dir:
       return self._eval_pass(state, eval_step, data_rng)
     return self._eval_poll_loop(state, eval_step, data_rng)

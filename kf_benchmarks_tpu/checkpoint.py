@@ -152,6 +152,62 @@ def restore_state(state, snapshot: dict):
   )
 
 
+def _lookup_path(tree, path):
+  """Navigate a nested state-dict by a jax key path; None if absent."""
+  node = tree
+  for p in path:
+    key = getattr(p, "key", None)
+    if key is None:
+      key = getattr(p, "idx", None)
+    if isinstance(node, dict) and str(key) in node:
+      node = node[str(key)]
+    else:
+      return None
+  return node
+
+
+def restore_backbone(state, path: str):
+  """Warm-start from a backbone checkpoint: restore the intersection of
+  the checkpoint's params/batch_stats with the live state, matched by
+  variable path and shape (ref: --backbone_model_path,
+  benchmark_cnn.py:2204-2205; models/model.py:170-190
+  add_backbone_saver/load_backbone_model -- the reference maps TF
+  variable names through a custom Saver; here module paths are the
+  names, so a backbone checkpoint is any checkpoint whose param paths
+  prefix-match the model's, e.g. an SSD300 checkpoint warm-starting the
+  ResNet-34 layers it shares).
+
+  Returns (new_state, num_restored_leaves).
+  """
+  snapshot = load_checkpoint(path)
+  restored = [0]
+
+  def merge(collection, snap_tree):
+    if snap_tree is None:
+      return collection
+    flat = jax.tree_util.tree_flatten_with_path(collection)[0]
+    replacements = {}
+    for key_path, leaf in flat:
+      found = _lookup_path(snap_tree, key_path)
+      if found is None:
+        continue
+      arr = np.asarray(found)
+      if arr.shape == tuple(leaf.shape[1:]):  # leaf is replica-stacked
+        replacements[key_path] = jnp.broadcast_to(
+            jnp.asarray(arr, leaf.dtype)[None], leaf.shape)
+        restored[0] += 1
+
+    def rebuild(key_path, leaf):
+      return replacements.get(key_path, leaf)
+
+    return jax.tree_util.tree_map_with_path(rebuild, collection)
+
+  new_state = state.replace(
+      params=merge(state.params, snapshot.get("params")),
+      batch_stats=merge(state.batch_stats, snapshot.get("batch_stats")))
+  return new_state, restored[0]
+
+
 def _restack(template, host_tree):
   """Saved trees round-trip through msgpack state-dict form (namedtuples
   become dicts), so restore via flax serialization against the live

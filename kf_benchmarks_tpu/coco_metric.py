@@ -64,33 +64,9 @@ def select_detections(pred_boxes: np.ndarray, pred_scores: np.ndarray
   return detections[:ssd_constants.MAX_NUM_EVAL_BOXES]
 
 
-def maybe_compute_map(results: dict, params=None) -> dict:
-  """Compute COCO mAP when possible; otherwise annotate and pass through
-  (ref: coco_metric.py compute_map; async wrapper ssd_model.py:481-539).
-
-  ``results`` carries accumulated per-image predictions under
-  'predictions': a list of {source_id, pred_boxes, pred_scores,
-  raw_shape}.
-  """
-  try:
-    from pycocotools.coco import COCO
-    from pycocotools.cocoeval import COCOeval
-  except ImportError:
-    results["coco_map_note"] = (
-        "pycocotools unavailable in this environment; mAP skipped")
-    return results
-  data_dir = getattr(params, "data_dir", None) if params else None
-  annotation_path = (os.path.join(data_dir, ssd_constants.ANNOTATION_FILE)
-                     if data_dir else None)
-  if not annotation_path or not os.path.exists(annotation_path):
-    results["coco_map_note"] = "annotation file not found; mAP skipped"
-    return results
-  predictions = results.get("predictions", [])
-  if not predictions:
-    # Skip before parsing the ~450k-annotation json for nothing.
-    results["coco_map_note"] = "no detections accumulated"
-    return results
-  coco_gt = COCO(annotation_path)
+def _build_detections(predictions) -> List[List[float]]:
+  """Accumulated per-image predictions -> COCO result rows
+  [image_id, x, y, w, h, score, category_id] in pixel coords."""
   detections = []
   for p in predictions:
     h, w = p["raw_shape"][:2]
@@ -103,15 +79,130 @@ def maybe_compute_map(results: dict, params=None) -> dict:
           d["score"],
           ssd_constants.CLASS_INV_MAP[d["label"]],
       ])
+  return detections
+
+
+def _iou_xywh(det: np.ndarray, gts: np.ndarray) -> np.ndarray:
+  """IoU of one [4] xywh box against [M,4] xywh boxes."""
+  x0 = np.maximum(det[0], gts[:, 0])
+  y0 = np.maximum(det[1], gts[:, 1])
+  x1 = np.minimum(det[0] + det[2], gts[:, 0] + gts[:, 2])
+  y1 = np.minimum(det[1] + det[3], gts[:, 1] + gts[:, 3])
+  inter = np.clip(x1 - x0, 0, None) * np.clip(y1 - y0, 0, None)
+  union = det[2] * det[3] + gts[:, 2] * gts[:, 3] - inter
+  return inter / np.clip(union, 1e-12, None)
+
+
+_IOU_THRS = np.arange(0.5, 1.0, 0.05)
+_RECALL_THRS = np.linspace(0.0, 1.0, 101)
+
+
+def compute_map_numpy(gt_json: dict, detections: List[List[float]]) -> dict:
+  """COCO bbox AP without pycocotools.
+
+  Pure-numpy re-implementation of COCOeval's bbox protocol (greedy
+  score-ordered matching per image/category at IoU thresholds
+  .50:.05:.95, 101-point interpolated precision, averaged over
+  categories present in the ground truth). pycocotools (C) is what the
+  reference uses (ref: coco_metric.py:33-178); it is not in this image,
+  so the fallback keeps the mAP path executable end-to-end.
+  """
+  gt_by_img_cat = {}
+  cats_with_gt = set()
+  for ann in gt_json.get("annotations", []):
+    if ann.get("iscrowd"):
+      continue
+    key = (int(ann["image_id"]), int(ann["category_id"]))
+    gt_by_img_cat.setdefault(key, []).append(ann["bbox"])
+    cats_with_gt.add(int(ann["category_id"]))
+  det_by_cat = {}
+  for row in detections:
+    det_by_cat.setdefault(int(row[6]), []).append(row)
+
+  ap_per_cat_thr = []  # (cat, thr_idx) -> AP
+  for cat in sorted(cats_with_gt):
+    rows = sorted(det_by_cat.get(cat, []), key=lambda r: -r[5])
+    n_gt = sum(len(v) for (img, c), v in gt_by_img_cat.items() if c == cat)
+    if n_gt == 0:
+      continue
+    aps = np.zeros(len(_IOU_THRS))
+    for ti, thr in enumerate(_IOU_THRS):
+      matched = {}  # (image_id) -> set of matched gt indices
+      tp = np.zeros(len(rows))
+      for di, row in enumerate(rows):
+        img = int(row[0])
+        gts = np.asarray(gt_by_img_cat.get((img, cat), []), np.float64)
+        if not len(gts):
+          continue
+        ious = _iou_xywh(np.asarray(row[1:5], np.float64), gts)
+        used = matched.setdefault(img, set())
+        order = np.argsort(-ious)
+        for gi in order:
+          if ious[gi] >= thr and int(gi) not in used:
+            used.add(int(gi))
+            tp[di] = 1.0
+            break
+      cum_tp = np.cumsum(tp)
+      cum_fp = np.cumsum(1.0 - tp)
+      recall = cum_tp / n_gt
+      precision = cum_tp / np.clip(cum_tp + cum_fp, 1e-12, None)
+      # Monotone-decreasing precision envelope, then 101-point sample.
+      for i in range(len(precision) - 2, -1, -1):
+        precision[i] = max(precision[i], precision[i + 1])
+      ap = 0.0
+      for r in _RECALL_THRS:
+        idx = np.searchsorted(recall, r, side="left")
+        ap += precision[idx] if idx < len(precision) else 0.0
+      aps[ti] = ap / len(_RECALL_THRS)
+    ap_per_cat_thr.append(aps)
+  if not ap_per_cat_thr:
+    return {"COCO/AP": 0.0, "COCO/AP50": 0.0}
+  stacked = np.stack(ap_per_cat_thr)  # [cats, thrs]
+  return {"COCO/AP": float(stacked.mean()),
+          "COCO/AP50": float(stacked[:, 0].mean())}
+
+
+def maybe_compute_map(results: dict, params=None) -> dict:
+  """Compute COCO mAP when possible; otherwise annotate and pass through
+  (ref: coco_metric.py compute_map; async wrapper ssd_model.py:481-539).
+
+  ``results`` carries accumulated per-image predictions under
+  'predictions': a list of {source_id, pred_boxes, pred_scores,
+  raw_shape}. Uses pycocotools when importable, else the in-repo numpy
+  evaluator (results['coco_evaluator'] records which ran).
+  """
+  data_dir = getattr(params, "data_dir", None) if params else None
+  annotation_path = (os.path.join(data_dir, ssd_constants.ANNOTATION_FILE)
+                     if data_dir else None)
+  if not annotation_path or not os.path.exists(annotation_path):
+    results["coco_map_note"] = "annotation file not found; mAP skipped"
+    return results
+  predictions = results.get("predictions", [])
+  if not predictions:
+    # Skip before parsing the ~450k-annotation json for nothing.
+    results["coco_map_note"] = "no detections accumulated"
+    return results
+  detections = _build_detections(predictions)
   if not detections:
     results["coco_map_note"] = "no detections accumulated"
     return results
-  coco_dt = coco_gt.loadRes(np.asarray(detections))
-  coco_eval = COCOeval(coco_gt, coco_dt, iouType="bbox")
-  coco_eval.evaluate()
-  coco_eval.accumulate()
-  coco_eval.summarize()
-  results["COCO/AP"] = float(coco_eval.stats[0])
-  results["COCO/AP50"] = float(coco_eval.stats[1])
+  try:
+    from pycocotools.coco import COCO
+    from pycocotools.cocoeval import COCOeval
+    coco_gt = COCO(annotation_path)
+    coco_dt = coco_gt.loadRes(np.asarray(detections))
+    coco_eval = COCOeval(coco_gt, coco_dt, iouType="bbox")
+    coco_eval.evaluate()
+    coco_eval.accumulate()
+    coco_eval.summarize()
+    results["COCO/AP"] = float(coco_eval.stats[0])
+    results["COCO/AP50"] = float(coco_eval.stats[1])
+    results["coco_evaluator"] = "pycocotools"
+  except ImportError:
+    import json
+    with open(annotation_path) as f:
+      gt_json = json.load(f)
+    results.update(compute_map_numpy(gt_json, detections))
+    results["coco_evaluator"] = "numpy"
   log_util.log_fn("COCO mAP: %.4f" % results["COCO/AP"])
   return results

@@ -1,0 +1,109 @@
+"""Fake COCO TFRecord + annotation generator.
+
+The analog of the reference's test-data fetch/generation utilities
+(ref: scripts/tf_cnn_benchmarks/test_data/tfrecord_image_generator.py and
+get_tf_record.py) for the detection path: writes object-detection-format
+TF Examples (image/encoded + image/object/bbox/* + image/object/
+class/label + image/source_id, the fields COCOPreprocessor parses) and a
+matching COCO ``instances`` annotation json so the mAP evaluator can run
+end-to-end against ground truth it can actually score.
+
+Images are solid-color squares with one bright axis-aligned rectangle per
+ground-truth box, deterministic per source_id.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+from typing import List, Tuple
+
+import numpy as np
+
+from kf_benchmarks_tpu.data import example as example_lib
+from kf_benchmarks_tpu.data import tfrecord
+from kf_benchmarks_tpu.models import ssd_constants
+
+
+def _jpeg_with_boxes(rng: np.random.RandomState, size: int,
+                     boxes: np.ndarray) -> bytes:
+  from PIL import Image
+  arr = np.full((size, size, 3), rng.randint(0, 64, size=3), np.uint8)
+  for ymin, xmin, ymax, xmax in boxes:
+    y0, x0 = int(ymin * size), int(xmin * size)
+    y1, x1 = max(int(ymax * size), y0 + 1), max(int(xmax * size), x0 + 1)
+    arr[y0:y1, x0:x1] = rng.randint(192, 256, size=3)
+  buf = io.BytesIO()
+  Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+  return buf.getvalue()
+
+
+def _random_boxes(rng: np.random.RandomState, n: int) -> np.ndarray:
+  """[n, 4] normalized (ymin, xmin, ymax, xmax), comfortably inside."""
+  y0 = rng.uniform(0.05, 0.5, size=n)
+  x0 = rng.uniform(0.05, 0.5, size=n)
+  h = rng.uniform(0.2, 0.45, size=n)
+  w = rng.uniform(0.2, 0.45, size=n)
+  return np.stack([y0, x0, np.minimum(y0 + h, 0.95),
+                   np.minimum(x0 + w, 0.95)], axis=1).astype(np.float32)
+
+
+def write_fake_coco(data_dir: str, num_train: int = 16,
+                    num_validation: int = 8, image_size: int = 300,
+                    max_boxes: int = 3, seed: int = 0) -> str:
+  """Write train/validation COCO TFRecord shards plus the annotation
+  json at ssd_constants.ANNOTATION_FILE. Returns the annotation path."""
+  os.makedirs(data_dir, exist_ok=True)
+  rng = np.random.RandomState(seed)
+  images_json: List[dict] = []
+  annotations_json: List[dict] = []
+  ann_id = 1
+  next_source_id = 1
+  for subset, count in (("train", num_train),
+                        ("validation", num_validation)):
+    path = os.path.join(data_dir, f"{subset}-00000-of-00001")
+    with tfrecord.TFRecordWriter(path) as w:
+      for _ in range(count):
+        source_id = next_source_id
+        next_source_id += 1
+        n = int(rng.randint(1, max_boxes + 1))
+        boxes = _random_boxes(rng, n)
+        # Raw (90-class) COCO category ids, as real records carry.
+        raw_classes = np.asarray(
+            [ssd_constants.CLASS_INV_MAP[int(rng.randint(1, 81))]
+             for _ in range(n)], np.int64)
+        record = example_lib.encode_example({
+            "image/encoded": _jpeg_with_boxes(rng, image_size, boxes),
+            "image/source_id": str(source_id).encode(),
+            "image/object/bbox/ymin": boxes[:, 0],
+            "image/object/bbox/xmin": boxes[:, 1],
+            "image/object/bbox/ymax": boxes[:, 2],
+            "image/object/bbox/xmax": boxes[:, 3],
+            "image/object/class/label": raw_classes,
+        })
+        w.write(record)
+        if subset == "validation":
+          images_json.append({"id": source_id, "height": image_size,
+                              "width": image_size})
+          for b, cls in zip(boxes, raw_classes):
+            x, y = float(b[1]) * image_size, float(b[0]) * image_size
+            bw = float(b[3] - b[1]) * image_size
+            bh = float(b[2] - b[0]) * image_size
+            annotations_json.append({
+                "id": ann_id, "image_id": source_id,
+                "category_id": int(cls),
+                "bbox": [x, y, bw, bh],
+                "area": bw * bh, "iscrowd": 0,
+            })
+            ann_id += 1
+  ann_path = os.path.join(data_dir, ssd_constants.ANNOTATION_FILE)
+  os.makedirs(os.path.dirname(ann_path), exist_ok=True)
+  with open(ann_path, "w") as f:
+    json.dump({
+        "images": images_json,
+        "annotations": annotations_json,
+        "categories": [{"id": int(c)} for c in
+                       ssd_constants.CLASS_INV_MAP[1:]],
+    }, f)
+  return ann_path

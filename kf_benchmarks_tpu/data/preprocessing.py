@@ -327,6 +327,235 @@ class Cifar10ImagePreprocessor(InputPreprocessor):
              all_labels[idx].astype(np.int32))
 
 
+class COCOPreprocessor(InputPreprocessor):
+  """SSD COCO detection pipeline (ref: preprocessing.py:742-894
+  COCOPreprocessor; ssd_dataloader.py:114-254 ssd_crop/color_jitter/
+  normalize_image).
+
+  Train batches: (images, (encoded_boxes, classes, num_matched)) -- the
+  anchor-space targets the SSD loss consumes (4-tuple, ref :806-811).
+  Eval batches: (images, (boxes, classes, source_ids, raw_shapes)) with
+  boxes trimmed/padded to MAX_NUM_EVAL_BOXES (5-tuple, ref :813-835).
+
+  Boxes are (ymin, xmin, ymax, xmax) normalized throughout -- the order
+  the TF example decoder and our encode_labels use (the reference's
+  ssd_crop mixes x-first crop rects with y-first boxes; we keep one
+  order).
+  """
+
+  def _record_stream(self, dataset, subset: str):
+    shards = tfrecord.list_shards(dataset.data_dir, subset)
+    shift = int(len(shards) * self.shift_ratio) % max(len(shards), 1)
+    shards = shards[shift:] + shards[:shift]
+    rng = random.Random(self.seed)
+    while True:
+      order = list(shards)
+      if self.train:
+        rng.shuffle(order)
+      for path in order:
+        yield from tfrecord.read_records(path)
+      if not self.train:
+        break  # eval: one pass over the validation set
+
+  @staticmethod
+  def parse_coco_example(record: bytes):
+    """COCO TF Example -> (image_buffer, boxes ltrb [N,4], classes [N]
+    contiguous 1..80, source_id). Raw 90-class COCO category ids map
+    through CLASS_MAP (ref: preprocessing.py:786-790)."""
+    from kf_benchmarks_tpu.models import ssd_constants
+    feats = example_lib.parse_example(record)
+    image_buffer = feats["image/encoded"][0]
+    def _coords(key):
+      v = feats.get(key)
+      return (np.asarray(v, np.float32) if v is not None and len(v)
+              else np.zeros((0,), np.float32))
+    ymin, xmin = _coords("image/object/bbox/ymin"), _coords(
+        "image/object/bbox/xmin")
+    ymax, xmax = _coords("image/object/bbox/ymax"), _coords(
+        "image/object/bbox/xmax")
+    boxes = (np.stack([ymin, xmin, ymax, xmax], axis=-1) if len(ymin)
+             else np.zeros((0, 4), np.float32))
+    raw = feats.get("image/object/class/label")
+    raw = np.asarray(raw, np.int64) if raw is not None else np.zeros(
+        (0,), np.int64)
+    class_map = np.asarray(ssd_constants.CLASS_MAP, np.int32)
+    classes = np.where((raw >= 0) & (raw < len(class_map)),
+                       class_map[np.clip(raw, 0, len(class_map) - 1)],
+                       -1).astype(np.int32)
+    keep = classes > 0
+    sid = feats.get("image/source_id")
+    if sid is not None and len(sid):
+      s = sid[0]
+      source_id = int(s) if not isinstance(s, bytes) else int(
+          s.decode() or 0)
+    else:
+      source_id = 0
+    return image_buffer, boxes[keep], classes[keep], source_id
+
+  def _ssd_crop(self, rng: "np.random.RandomState", boxes: np.ndarray):
+    """IoU-biased random crop sampling (ref: ssd_dataloader.py:114-227
+    ssd_crop). Returns (crop ltrb, box mask) in normalized coords.
+
+    Per pass: with P_NO_CROP probability keep the whole image; otherwise
+    draw NUM_CROP_PASSES candidate rects (side in [0.3,1], aspect < 2),
+    require every gt box's IoU with the rect above a randomly drawn
+    threshold and at least one box center inside; take the highest-index
+    valid candidate (the reference's max-index selection). Repeat until
+    a crop is accepted (bounded here; whole image on exhaustion)."""
+    from kf_benchmarks_tpu.models import ssd_constants
+    whole = np.array([0.0, 0.0, 1.0, 1.0], np.float32)
+    all_mask = np.ones((len(boxes),), bool)
+    for _ in range(100):
+      if rng.uniform() < ssd_constants.P_NO_CROP_PER_PASS:
+        return whole, all_mask
+      n = ssd_constants.NUM_CROP_PASSES
+      h = rng.uniform(0.3, 1.0, size=n)
+      w = rng.uniform(0.3, 1.0, size=n)
+      top = rng.uniform(0, 1, size=n) * (1 - h)
+      left = rng.uniform(0, 1, size=n) * (1 - w)
+      rects = np.stack([top, left, top + h, left + w], axis=1)
+      min_iou = ssd_constants.CROP_MIN_IOU_CHOICES[
+          rng.randint(len(ssd_constants.CROP_MIN_IOU_CHOICES))]
+      from kf_benchmarks_tpu.models import ssd_dataloader
+      ious = ssd_dataloader.calc_iou_matrix(rects.astype(np.float32),
+                                            boxes)
+      yc = 0.5 * (boxes[:, 0] + boxes[:, 2])
+      xc = 0.5 * (boxes[:, 1] + boxes[:, 3])
+      centers_in = ((yc[None, :] > rects[:, 0:1]) &
+                    (yc[None, :] < rects[:, 2:3]) &
+                    (xc[None, :] > rects[:, 1:2]) &
+                    (xc[None, :] < rects[:, 3:4]))
+      valid_aspect = (h / w < 2) & (w / h < 2)
+      valid = (valid_aspect & np.all(ious > min_iou, axis=1) &
+               np.any(centers_in, axis=1))
+      if np.any(valid):
+        i = int(np.max(np.nonzero(valid)[0]))
+        return rects[i].astype(np.float32), centers_in[i]
+    return whole, all_mask
+
+  def _color_jitter(self, img: "Image.Image",
+                    rng: "np.random.RandomState") -> "Image.Image":
+    """brightness=0.125, contrast=0.5, saturation=0.5, hue=0.05
+    (ref: ssd_dataloader.py:230-243 color_jitter)."""
+    img = ImageEnhance.Brightness(img).enhance(
+        1.0 + rng.uniform(-0.125, 0.125))
+    img = ImageEnhance.Contrast(img).enhance(rng.uniform(0.5, 1.5))
+    img = ImageEnhance.Color(img).enhance(rng.uniform(0.5, 1.5))
+    # Hue shift +/-0.05 of the hue circle, via the HSV plane.
+    hsv = np.asarray(img.convert("HSV"), np.int16)
+    hsv[..., 0] = (hsv[..., 0] +
+                   int(rng.uniform(-0.05, 0.05) * 255)) % 256
+    return Image.fromarray(hsv.astype(np.uint8), "HSV").convert("RGB")
+
+  def _normalize(self, arr: np.ndarray) -> np.ndarray:
+    """[0,255] uint8 -> zero-mean unit-var float32 per ImageNet stats
+    (ref: ssd_dataloader.py:246-254 normalize_image)."""
+    from kf_benchmarks_tpu.models import ssd_constants
+    arr = arr.astype(np.float32) / 255.0
+    mean = np.asarray(ssd_constants.NORMALIZATION_MEAN, np.float32)
+    std = np.asarray(ssd_constants.NORMALIZATION_STD, np.float32)
+    return (arr - mean) / std
+
+  def _preprocess_train(self, parsed, rng: "np.random.RandomState"):
+    from kf_benchmarks_tpu.models import ssd_dataloader
+    image_buffer, boxes, classes, _ = parsed
+    img = Image.open(io.BytesIO(image_buffer)).convert("RGB")
+    crop, mask = self._ssd_crop(rng, boxes)
+    iw, ih = img.size
+    y0, x0, y1, x1 = crop
+    img = img.crop((int(x0 * iw), int(y0 * ih),
+                    max(int(x1 * iw), int(x0 * iw) + 1),
+                    max(int(y1 * ih), int(y0 * ih) + 1)))
+    img = img.resize((self.width, self.height), Image.BILINEAR)
+    boxes, classes = boxes[mask], classes[mask]
+    # Clip surviving boxes to the crop and renormalize to crop coords.
+    ch, cw = max(y1 - y0, 1e-6), max(x1 - x0, 1e-6)
+    boxes = np.stack([
+        (np.clip(boxes[:, 0], y0, y1) - y0) / ch,
+        (np.clip(boxes[:, 1], x0, x1) - x0) / cw,
+        (np.clip(boxes[:, 2], y0, y1) - y0) / ch,
+        (np.clip(boxes[:, 3], x0, x1) - x0) / cw,
+    ], axis=1) if len(boxes) else boxes
+    if rng.uniform() < 0.5:  # random_horizontal_flip (image + boxes)
+      img = img.transpose(Image.FLIP_LEFT_RIGHT)
+      if len(boxes):
+        boxes = np.stack([boxes[:, 0], 1.0 - boxes[:, 3],
+                          boxes[:, 2], 1.0 - boxes[:, 1]], axis=1)
+    if self.distortions:
+      img = self._color_jitter(img, rng)
+    image = self._normalize(np.asarray(img, np.uint8))
+    encoded, enc_classes, num_matched = ssd_dataloader.encode_labels(
+        boxes.astype(np.float32), classes)
+    return image, encoded, enc_classes, np.float32(num_matched)
+
+  def _preprocess_eval(self, parsed):
+    from kf_benchmarks_tpu.models import ssd_constants
+    image_buffer, boxes, classes, source_id = parsed
+    img = Image.open(io.BytesIO(image_buffer)).convert("RGB")
+    iw, ih = img.size
+    img = img.resize((self.width, self.height), Image.BILINEAR)
+    image = self._normalize(np.asarray(img, np.uint8))
+    m = ssd_constants.MAX_NUM_EVAL_BOXES
+
+    def trim_and_pad(arr, width):
+      arr = arr[:m]
+      out = np.zeros((m, width), np.float32)
+      if len(arr):
+        out[:len(arr)] = arr.reshape(len(arr), width)
+      return out
+
+    return (image, trim_and_pad(boxes, 4),
+            trim_and_pad(classes.astype(np.float32), 1),
+            np.int32(source_id), np.asarray([ih, iw, 3], np.int32))
+
+  def minibatches(self, dataset, subset: str):
+    if not _HAVE_PIL:  # pragma: no cover
+      raise NotImplementedError("PIL is required for the COCO pipeline")
+    stream = self._record_stream(dataset, subset)
+    pool = concurrent.futures.ThreadPoolExecutor(self.num_threads)
+    rngs = [np.random.RandomState(self.seed + 7919 * i)
+            for i in range(self.batch_size)]
+    try:
+      exhausted = False
+      while not exhausted:
+        batch_parsed = []
+        for record in stream:
+          parsed = self.parse_coco_example(record)
+          # Training filters examples with no ground-truth boxes
+          # (ref :887-888); eval keeps them -- their ground truth is
+          # empty, but dropping images would bias mAP's recall
+          # denominator (every val image must be scored).
+          if self.train and not len(parsed[1]):
+            continue
+          batch_parsed.append(parsed)
+          if len(batch_parsed) == self.batch_size:
+            break
+        if len(batch_parsed) < self.batch_size:
+          exhausted = True  # eval: still yield the final partial batch
+          if not batch_parsed:
+            return
+        if self.train:
+          futs = [pool.submit(self._preprocess_train, parsed, rngs[i])
+                  for i, parsed in enumerate(batch_parsed)]
+          results = [f.result() for f in futs]
+          images = np.stack([r[0] for r in results])
+          boxes = np.stack([r[1] for r in results])
+          classes = np.stack([r[2] for r in results])
+          num_matched = np.asarray([r[3] for r in results], np.float32)
+          yield images, (boxes, classes, num_matched)
+        else:
+          futs = [pool.submit(self._preprocess_eval, parsed)
+                  for parsed in batch_parsed]
+          results = [f.result() for f in futs]
+          yield (np.stack([r[0] for r in results]),
+                 (np.stack([r[1] for r in results]),
+                  np.stack([r[2] for r in results]),
+                  np.asarray([r[3] for r in results], np.int32),
+                  np.stack([r[4] for r in results])))
+    finally:
+      pool.shutdown(wait=False)
+
+
 class TestImagePreprocessor(InputPreprocessor):
   """Injects fake numpy data as "real" input (ref:
   preprocessing.py:896-975). ``set_fake_data`` then iterate."""
@@ -358,6 +587,7 @@ class TestImagePreprocessor(InputPreprocessor):
 _PREPROCESSORS = {
     "imagenet": RecordInputImagePreprocessor,
     "cifar10": Cifar10ImagePreprocessor,
+    "coco": COCOPreprocessor,
     "test": TestImagePreprocessor,
 }
 

@@ -215,12 +215,9 @@ class SSD300Model(model_lib.CNNModel):
     anchors = ssd_dataloader._default_boxes_singleton()("xywh")
     decoded = ssd_dataloader.decode_boxes(pred_loc, anchors)
     # Benchmark-loop compatibility: detection reports a proxy "accuracy"
-    # of mean max-class confidence so the shared eval loop has scalars.
-    # The decoded per-box arrays are returned for callers that accumulate
-    # predictions for COCO mAP (postprocess + coco_metric); the shared
-    # jitted eval step keeps only the scalars -- full mAP accumulation
-    # needs the real-COCO eval input path (per-image source ids), which
-    # is not wired yet.
+    # of mean max-class confidence so the shared eval loop has scalars on
+    # the synthetic path. Real-COCO eval (per-image accumulation + mAP)
+    # runs through evaluate_real_data below instead.
     top_conf = jnp.max(pred_scores[..., 1:], axis=-1)
     return {"top_1_accuracy": jnp.mean(top_conf),
             "top_5_accuracy": jnp.mean(top_conf),
@@ -235,6 +232,65 @@ class SSD300Model(model_lib.CNNModel):
     except ImportError:
       return results
     return coco_metric.maybe_compute_map(results, self.params)
+
+  def evaluate_real_data(self, variables, params, dataset):
+    """Real-COCO validation eval: forward the eval module over the
+    validation stream, decode + accumulate per-image predictions, then
+    compute mAP (ref: _eval_once accuracy accumulation + postprocess,
+    ssd_model.py:430-539; benchmark.py dispatches here because detection
+    eval is per-image accumulation, not the scalar top-k loop).
+
+    ``variables`` is the unstacked {'params': ..., 'batch_stats': ...}
+    flax variables dict. Returns the postprocess()ed results dict.
+    """
+    import numpy as np
+    from kf_benchmarks_tpu.data import preprocessing as pre_lib
+    from kf_benchmarks_tpu.parallel import mesh as mesh_lib
+    self.params = params  # postprocess reads data_dir for annotations
+    module = self.make_module(self.label_num, phase_train=False)
+    # Global batch sharded over the mesh: detection eval is embarrassingly
+    # batch-parallel, so it uses every device like the shared eval loop.
+    num_devices = max(getattr(params, "num_devices", 1) or 1, 1)
+    batch = self.get_batch_size() * num_devices
+    mesh = mesh_lib.build_mesh(num_devices, params.device)
+    batch_sharding = mesh_lib.batch_sharding(mesh)
+    variables = jax.device_put(variables,
+                               mesh_lib.replicated_sharding(mesh))
+    pre = pre_lib.COCOPreprocessor(
+        batch_size=batch,
+        output_shape=(self.image_size, self.image_size, self.depth),
+        train=False, distortions=False, resize_method="bilinear",
+        seed=params.tf_random_seed or 301, shift_ratio=0.0,
+        num_threads=params.datasets_num_private_threads or 4)
+    apply_fn = jax.jit(lambda v, x: module.apply(v, x))
+    anchors = ssd_dataloader._default_boxes_singleton()("xywh")
+    predictions = []
+    num_batches = 0
+    for images, (_, _, source_ids, raw_shapes) in pre.minibatches(
+        dataset, "validation"):
+      x = jnp.asarray(images)
+      if x.shape[0] % num_devices == 0:
+        x = jax.device_put(x, batch_sharding)
+      logits, _ = apply_fn(variables, x)
+      logits = np.asarray(logits)
+      decoded = np.asarray(
+          ssd_dataloader.decode_boxes(jnp.asarray(logits[..., :4]),
+                                      anchors))
+      scores = np.asarray(jax.nn.softmax(jnp.asarray(logits[..., 4:]),
+                                         axis=-1))
+      for b in range(len(images)):
+        predictions.append({
+            "source_id": int(source_ids[b]),
+            "pred_boxes": decoded[b],
+            "pred_scores": scores[b],
+            "raw_shape": np.asarray(raw_shapes[b]),
+        })
+      num_batches += 1
+      if params.num_eval_batches and num_batches >= params.num_eval_batches:
+        break
+    results = {"predictions": predictions,
+               "num_eval_images": len(predictions)}
+    return self.postprocess(results)
 
 
 def create_ssd300_model(params=None):
