@@ -320,6 +320,10 @@ class BenchmarkCNN:
           shift_ratio=(kungfu.current_rank() /
                        max(kungfu.current_cluster_size(), 1)),
           num_threads=p.datasets_num_private_threads or 8)
+      if hasattr(pre, "max_label_length"):
+        # Speech: label padding must match the model's static label slot.
+        pre.max_label_length = getattr(self.model, "max_label_length",
+                                       pre.max_label_length)
     host_iter = pre.minibatches(self.dataset, subset)
     if self.compute_dtype != jnp.float32:
       host_iter = self._cast_images(host_iter)
@@ -749,17 +753,24 @@ class BenchmarkCNN:
       for done in pipe.push(i + 1, acc):
         accs.append(done.metrics)
       if next_batch is not None and i + 1 < num_eval:
-        images, labels = next_batch()
+        try:
+          images, labels = next_batch()
+        except StopIteration:
+          # Real-data validation streams are one-pass (data/preprocessing
+          # _record_stream); stopping at exhaustion bounds eval by
+          # min(num_eval_batches, one epoch), as the reference does.
+          break
     for done in pipe.flush():
       accs.append(done.metrics)
     for acc in accs:
       top1_sum += float(acc["top_1_accuracy"])
       top5_sum += float(acc["top_5_accuracy"])
     elapsed = time.time() - start
-    top1, top5 = top1_sum / num_eval, top5_sum / num_eval
+    evaluated = max(len(accs), 1)
+    top1, top5 = top1_sum / evaluated, top5_sum / evaluated
     log_fn("Accuracy @ 1 = %.4f Accuracy @ 5 = %.4f [%d examples]" %
-           (top1, top5, num_eval * self.batch_size))
-    eval_ips = num_eval * self.batch_size / max(elapsed, 1e-9)
+           (top1, top5, evaluated * self.batch_size))
+    eval_ips = evaluated * self.batch_size / max(elapsed, 1e-9)
     if p.benchmark_log_dir:
       # Eval-result emission (ref: benchmark_cnn.py:1915-1922). The
       # state's step is the restored checkpoint's global step, so
@@ -818,7 +829,13 @@ class BenchmarkCNN:
     per eval, ref: benchmark_cnn.py:1829-1862 _initialize_eval_graph)."""
     next_batch, stop_input = self._input_iterator(data_rng, "validation")
     try:
-      images, labels = next_batch()
+      try:
+        images, labels = next_batch()
+      except StopIteration:
+        log_fn("Validation stream yielded no batches (fewer examples "
+               "than the global batch size?)")
+        return {"top_1_accuracy": 0.0, "top_5_accuracy": 0.0,
+                "eval_images_per_sec": 0.0}
       real_data = not self.dataset.use_synthetic_gpu_inputs()
       return self._eval_once(state, eval_step, images, labels,
                              next_batch if real_data else None)

@@ -97,53 +97,93 @@ _IOU_THRS = np.arange(0.5, 1.0, 0.05)
 _RECALL_THRS = np.linspace(0.0, 1.0, 101)
 
 
+_MAX_DETS = 100  # COCOeval maxDets for the headline AP
+
+
 def compute_map_numpy(gt_json: dict, detections: List[List[float]]) -> dict:
   """COCO bbox AP without pycocotools.
 
-  Pure-numpy re-implementation of COCOeval's bbox protocol (greedy
-  score-ordered matching per image/category at IoU thresholds
-  .50:.05:.95, 101-point interpolated precision, averaged over
-  categories present in the ground truth). pycocotools (C) is what the
-  reference uses (ref: coco_metric.py:33-178); it is not in this image,
-  so the fallback keeps the mAP path executable end-to-end.
+  Pure-numpy re-implementation of COCOeval's bbox protocol: top-100
+  detections per image, greedy score-ordered matching per image/category
+  at IoU thresholds .50:.05:.95, detections unmatched to real ground
+  truth but overlapping an iscrowd region are ignored (neither TP nor
+  FP; crowd overlap uses intersection/det_area as pycocotools does),
+  101-point interpolated precision averaged over categories present in
+  the ground truth. pycocotools (C) is what the reference uses
+  (ref: coco_metric.py:33-178); it is not in this image, so this
+  fallback keeps the mAP path executable end-to-end.
   """
   gt_by_img_cat = {}
+  crowd_by_img_cat = {}
   cats_with_gt = set()
   for ann in gt_json.get("annotations", []):
-    if ann.get("iscrowd"):
-      continue
     key = (int(ann["image_id"]), int(ann["category_id"]))
-    gt_by_img_cat.setdefault(key, []).append(ann["bbox"])
-    cats_with_gt.add(int(ann["category_id"]))
-  det_by_cat = {}
-  for row in detections:
-    det_by_cat.setdefault(int(row[6]), []).append(row)
+    if ann.get("iscrowd"):
+      crowd_by_img_cat.setdefault(key, []).append(ann["bbox"])
+    else:
+      gt_by_img_cat.setdefault(key, []).append(ann["bbox"])
+      cats_with_gt.add(int(ann["category_id"]))
 
-  ap_per_cat_thr = []  # (cat, thr_idx) -> AP
+  # maxDets cap: keep each image's top-100 detections by score.
+  det_by_img = {}
+  for row in detections:
+    det_by_img.setdefault(int(row[0]), []).append(row)
+  det_by_cat = {}
+  for img, rows in det_by_img.items():
+    rows.sort(key=lambda r: -r[5])
+    for row in rows[:_MAX_DETS]:
+      det_by_cat.setdefault(int(row[6]), []).append(row)
+
+  ap_per_cat_thr = []  # [cats, thrs]
   for cat in sorted(cats_with_gt):
     rows = sorted(det_by_cat.get(cat, []), key=lambda r: -r[5])
     n_gt = sum(len(v) for (img, c), v in gt_by_img_cat.items() if c == cat)
     if n_gt == 0:
       continue
+    # IoUs are threshold-independent: compute each detection's IoU
+    # vector against its image's gt (and crowd overlap) exactly once.
+    gt_arrays = {}
+    det_ious = []      # per detection: (image_id, iou vector over gts)
+    det_crowd = []     # per detection: max intersection/det_area vs crowds
+    for row in rows:
+      img = int(row[0])
+      if img not in gt_arrays:
+        gt_arrays[img] = np.asarray(gt_by_img_cat.get((img, cat), []),
+                                    np.float64).reshape(-1, 4)
+      gts = gt_arrays[img]
+      det = np.asarray(row[1:5], np.float64)
+      det_ious.append((img, _iou_xywh(det, gts) if len(gts) else
+                       np.zeros((0,))))
+      crowds = np.asarray(crowd_by_img_cat.get((img, cat), []),
+                          np.float64).reshape(-1, 4)
+      if len(crowds) and det[2] * det[3] > 0:
+        x0 = np.maximum(det[0], crowds[:, 0])
+        y0 = np.maximum(det[1], crowds[:, 1])
+        x1 = np.minimum(det[0] + det[2], crowds[:, 0] + crowds[:, 2])
+        y1 = np.minimum(det[1] + det[3], crowds[:, 1] + crowds[:, 3])
+        inter = np.clip(x1 - x0, 0, None) * np.clip(y1 - y0, 0, None)
+        det_crowd.append(float(np.max(inter / (det[2] * det[3]))))
+      else:
+        det_crowd.append(0.0)
     aps = np.zeros(len(_IOU_THRS))
     for ti, thr in enumerate(_IOU_THRS):
-      matched = {}  # (image_id) -> set of matched gt indices
+      matched = {}  # image_id -> set of matched gt indices
       tp = np.zeros(len(rows))
-      for di, row in enumerate(rows):
-        img = int(row[0])
-        gts = np.asarray(gt_by_img_cat.get((img, cat), []), np.float64)
-        if not len(gts):
-          continue
-        ious = _iou_xywh(np.asarray(row[1:5], np.float64), gts)
+      ignored = np.zeros(len(rows), bool)
+      for di, (img, ious) in enumerate(det_ious):
         used = matched.setdefault(img, set())
-        order = np.argsort(-ious)
-        for gi in order:
+        hit = False
+        for gi in np.argsort(-ious):
           if ious[gi] >= thr and int(gi) not in used:
             used.add(int(gi))
             tp[di] = 1.0
+            hit = True
             break
-      cum_tp = np.cumsum(tp)
-      cum_fp = np.cumsum(1.0 - tp)
+        if not hit and det_crowd[di] >= thr:
+          ignored[di] = True  # crowd overlap: neither TP nor FP
+      keep = ~ignored
+      cum_tp = np.cumsum(tp[keep])
+      cum_fp = np.cumsum(1.0 - tp[keep])
       recall = cum_tp / n_gt
       precision = cum_tp / np.clip(cum_tp + cum_fp, 1e-12, None)
       # Monotone-decreasing precision envelope, then 101-point sample.

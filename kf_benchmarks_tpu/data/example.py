@@ -128,25 +128,14 @@ def _parse_feature(buf: bytes) -> FeatureValue:
   return []
 
 
-def parse_example(record: bytes) -> Dict[str, FeatureValue]:
-  """Decode a serialized Example into {feature_name: value}."""
-  features: Dict[str, FeatureValue] = {}
+def _parse_map_entries(buf: bytes):
+  """Iterate (key, value_buf) of a map<string, Message> field -- map
+  entries are repeated messages { key = 1; value = 2; }."""
   pos = 0
-  # Example { features = 1 }
-  feats_buf = b""
-  while pos < len(record):
-    tag, pos = _read_varint(record, pos)
+  while pos < len(buf):
+    tag, pos = _read_varint(buf, pos)
     if tag == (1 << 3) | 2:
-      feats_buf, pos = _read_len_delimited(record, pos)
-    else:
-      pos = _skip_field(record, pos, tag & 7)
-  # Features { map<string, Feature> feature = 1 } -- map entries are
-  # repeated messages { key = 1; value = 2; }
-  pos = 0
-  while pos < len(feats_buf):
-    tag, pos = _read_varint(feats_buf, pos)
-    if tag == (1 << 3) | 2:
-      entry, pos = _read_len_delimited(feats_buf, pos)
+      entry, pos = _read_len_delimited(buf, pos)
       key = None
       value_buf = b""
       p2 = 0
@@ -160,10 +149,66 @@ def parse_example(record: bytes) -> Dict[str, FeatureValue]:
         else:
           p2 = _skip_field(entry, p2, t2 & 7)
       if key is not None:
-        features[key] = _parse_feature(value_buf)
+        yield key, value_buf
     else:
-      pos = _skip_field(feats_buf, pos, tag & 7)
-  return features
+      pos = _skip_field(buf, pos, tag & 7)
+
+
+def _parse_features(feats_buf: bytes) -> Dict[str, FeatureValue]:
+  """Features { map<string, Feature> feature = 1 }."""
+  return {key: _parse_feature(value_buf)
+          for key, value_buf in _parse_map_entries(feats_buf)}
+
+
+def parse_example(record: bytes) -> Dict[str, FeatureValue]:
+  """Decode a serialized Example into {feature_name: value}."""
+  pos = 0
+  # Example { features = 1 }
+  feats_buf = b""
+  while pos < len(record):
+    tag, pos = _read_varint(record, pos)
+    if tag == (1 << 3) | 2:
+      feats_buf, pos = _read_len_delimited(record, pos)
+    else:
+      pos = _skip_field(record, pos, tag & 7)
+  return _parse_features(feats_buf)
+
+
+def parse_sequence_example(record: bytes):
+  """Decode a serialized SequenceExample into (context, feature_lists).
+
+  SequenceExample { Features context = 1; FeatureLists feature_lists = 2; }
+  FeatureLists    { map<string, FeatureList> feature_list = 1; }
+  FeatureList     { repeated Feature feature = 1; }
+
+  The reference parses these with ``tf.io.parse_single_sequence_example``
+  (ref: preprocessing.py:1081-1101 LibrispeechPreprocessor). Returns
+  (dict[str, FeatureValue], dict[str, list[FeatureValue]]).
+  """
+  pos = 0
+  context_buf = b""
+  lists_buf = b""
+  while pos < len(record):
+    tag, pos = _read_varint(record, pos)
+    if tag == (1 << 3) | 2:
+      context_buf, pos = _read_len_delimited(record, pos)
+    elif tag == (2 << 3) | 2:
+      lists_buf, pos = _read_len_delimited(record, pos)
+    else:
+      pos = _skip_field(record, pos, tag & 7)
+  feature_lists: Dict[str, List[FeatureValue]] = {}
+  for key, fl_buf in _parse_map_entries(lists_buf):
+    steps: List[FeatureValue] = []
+    p = 0
+    while p < len(fl_buf):
+      tag, p = _read_varint(fl_buf, p)
+      if tag == (1 << 3) | 2:
+        feat_buf, p = _read_len_delimited(fl_buf, p)
+        steps.append(_parse_feature(feat_buf))
+      else:
+        p = _skip_field(fl_buf, p, tag & 7)
+    feature_lists[key] = steps
+  return _parse_features(context_buf), feature_lists
 
 
 # -- encode ------------------------------------------------------------------
@@ -203,13 +248,36 @@ def _encode_feature(value) -> bytes:
   return bytes(inner)
 
 
-def encode_example(features: Dict[str, FeatureValue]) -> bytes:
+def _encode_features(features: Dict[str, FeatureValue]) -> bytes:
   feats = bytearray()
   for key, value in features.items():
     entry = bytearray()
     _len_delimited(entry, 1, key.encode("utf-8"))
     _len_delimited(entry, 2, _encode_feature(value))
     _len_delimited(feats, 1, bytes(entry))
+  return bytes(feats)
+
+
+def encode_example(features: Dict[str, FeatureValue]) -> bytes:
   out = bytearray()
-  _len_delimited(out, 1, bytes(feats))
+  _len_delimited(out, 1, _encode_features(features))
+  return bytes(out)
+
+
+def encode_sequence_example(context: Dict[str, FeatureValue],
+                            feature_lists: Dict[str, Sequence]) -> bytes:
+  """Encode a SequenceExample (inverse of parse_sequence_example).
+  ``feature_lists`` values are sequences of per-step feature values."""
+  lists = bytearray()
+  for key, steps in feature_lists.items():
+    fl = bytearray()
+    for step in steps:
+      _len_delimited(fl, 1, _encode_feature(step))
+    entry = bytearray()
+    _len_delimited(entry, 1, key.encode("utf-8"))
+    _len_delimited(entry, 2, bytes(fl))
+    _len_delimited(lists, 1, bytes(entry))
+  out = bytearray()
+  _len_delimited(out, 1, _encode_features(context))
+  _len_delimited(out, 2, bytes(lists))
   return bytes(out)

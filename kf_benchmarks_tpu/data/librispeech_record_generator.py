@@ -1,0 +1,50 @@
+"""Fake Librispeech SequenceExample generator.
+
+Writes the record format the reference's DeepSpeech2 path consumes
+(ref: scripts/tf_cnn_benchmarks/preprocessing.py:1081-1112): per-frame
+161-bin spectrogram features as a sequence feature plus context labels/
+lengths. Real Librispeech prep computes these features offline from the
+audio (the official deepspeech featurizer); this generator fabricates
+short random utterances so the pipeline and CTC training run end-to-end
+without the 1000-hour corpus.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from kf_benchmarks_tpu.data import example as example_lib
+from kf_benchmarks_tpu.data import tfrecord
+
+NUM_FEATURE_BINS = 161
+# Character labels 1..28 (a-z, space, apostrophe); 0 reserved, 28 blank
+# in the model's alphabet (ref: deepspeech.py labels).
+NUM_CHAR_CLASSES = 27
+
+
+def write_fake_librispeech(data_dir: str, num_train: int = 8,
+                           num_validation: int = 4,
+                           min_frames: int = 40, max_frames: int = 120,
+                           max_label_len: int = 30, seed: int = 0) -> None:
+  os.makedirs(data_dir, exist_ok=True)
+  rng = np.random.RandomState(seed)
+  for subset, count in (("train", num_train),
+                        ("validation", num_validation)):
+    path = os.path.join(data_dir, f"{subset}-00000-of-00001")
+    with tfrecord.TFRecordWriter(path) as w:
+      for _ in range(count):
+        t = int(rng.randint(min_frames, max_frames + 1))
+        l = int(rng.randint(5, max_label_len + 1))
+        frames = rng.randn(t, NUM_FEATURE_BINS).astype(np.float32)
+        labels = rng.randint(1, NUM_CHAR_CLASSES + 1,
+                             size=l).astype(np.int64)
+        record = example_lib.encode_sequence_example(
+            context={
+                "labels": labels,
+                "input_length": np.asarray([t], np.int64),
+                "label_length": np.asarray([l], np.int64),
+            },
+            feature_lists={"features": [frames[i] for i in range(t)]})
+        w.write(record)

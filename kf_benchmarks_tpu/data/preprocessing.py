@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import io
+import itertools
 import os
 import pickle
 import random
@@ -217,18 +218,13 @@ class InputPreprocessor:
       Tuple[np.ndarray, np.ndarray]]:
     raise NotImplementedError
 
-  def supports_datasets(self) -> bool:
-    return True
-
-
-class RecordInputImagePreprocessor(InputPreprocessor):
-  """TFRecord image classification pipeline
-  (ref: preprocessing.py:551-632)."""
-
   def _record_stream(self, dataset, subset: str) -> Iterator[bytes]:
+    """Shared TFRecord shard stream: shift_ratio de-overlap (ref:
+    RecordInput shift_ratio, preprocessing.py:601-617), shard-order
+    shuffle + endless replay for training, ONE pass for eval (the
+    reference bounds eval by num_eval_batches over a single epoch;
+    consumers handle exhaustion -- see BenchmarkCNN._eval_once)."""
     shards = tfrecord.list_shards(dataset.data_dir, subset)
-    # shift_ratio de-overlap: rotate the shard order per worker
-    # (ref: RecordInput shift_ratio, preprocessing.py:601-617).
     shift = int(len(shards) * self.shift_ratio) % max(len(shards), 1)
     shards = shards[shift:] + shards[:shift]
     rng = random.Random(self.seed)
@@ -238,6 +234,16 @@ class RecordInputImagePreprocessor(InputPreprocessor):
         rng.shuffle(order)
       for path in order:
         yield from tfrecord.read_records(path)
+      if not self.train:
+        break
+
+  def supports_datasets(self) -> bool:
+    return True
+
+
+class RecordInputImagePreprocessor(InputPreprocessor):
+  """TFRecord image classification pipeline
+  (ref: preprocessing.py:551-632)."""
 
   def _preprocess_one(self, record: bytes, batch_position: int,
                       rng: random.Random) -> Tuple[np.ndarray, int]:
@@ -260,7 +266,9 @@ class RecordInputImagePreprocessor(InputPreprocessor):
             for i in range(self.batch_size)]
     try:
       while True:
-        records = [next(stream) for _ in range(self.batch_size)]
+        records = list(itertools.islice(stream, self.batch_size))
+        if len(records) < self.batch_size:
+          return  # eval stream exhausted (train replays forever)
         futs = [pool.submit(self._preprocess_one, rec, i, rngs[i])
                 for i, rec in enumerate(records)]
         results = [f.result() for f in futs]
@@ -342,20 +350,6 @@ class COCOPreprocessor(InputPreprocessor):
   ssd_crop mixes x-first crop rects with y-first boxes; we keep one
   order).
   """
-
-  def _record_stream(self, dataset, subset: str):
-    shards = tfrecord.list_shards(dataset.data_dir, subset)
-    shift = int(len(shards) * self.shift_ratio) % max(len(shards), 1)
-    shards = shards[shift:] + shards[:shift]
-    rng = random.Random(self.seed)
-    while True:
-      order = list(shards)
-      if self.train:
-        rng.shuffle(order)
-      for path in order:
-        yield from tfrecord.read_records(path)
-      if not self.train:
-        break  # eval: one pass over the validation set
 
   @staticmethod
   def parse_coco_example(record: bytes):
@@ -556,6 +550,69 @@ class COCOPreprocessor(InputPreprocessor):
       pool.shutdown(wait=False)
 
 
+class LibrispeechPreprocessor(InputPreprocessor):
+  """Librispeech speech pipeline (ref: preprocessing.py:977-1112
+  LibrispeechPreprocessor).
+
+  Records are SequenceExample protos carrying precomputed spectrogram
+  features (sequence feature 'features', [T, 161] float32 frames) plus
+  context 'labels' (varlen int64), 'input_length', 'label_length' --
+  exactly what the reference parses with parse_single_sequence_example
+  (:1081-1112). The reference pads per-batch via padded_batch (dynamic
+  shapes); XLA needs static shapes, so every utterance pads to the
+  model's max_time_steps/max_label_length (over-long utterances truncate
+  and clamp their lengths) -- the static-shape analog of its bucketing.
+
+  Batches: (spectrogram [n, max_T, bins, 1],
+            (labels [n, max_label], input_lengths [n], label_lengths [n])).
+  """
+
+  def __init__(self, *args, max_label_length: int = 576, **kwargs):
+    super().__init__(*args, **kwargs)
+    # output_shape carries the model's (max_time_steps, num_bins, 1).
+    self.max_time_steps = self.height
+    self.num_feature_bins = self.width
+    self.max_label_length = max_label_length
+
+  def _parse_utterance(self, record: bytes):
+    context, seqs = example_lib.parse_sequence_example(record)
+    frames = seqs.get("features", [])
+    feats = (np.stack([np.asarray(f, np.float32) for f in frames])
+             if frames else np.zeros((0, self.num_feature_bins),
+                                     np.float32))
+    labels = np.asarray(context.get("labels", []), np.int64)
+    t = min(len(feats), self.max_time_steps)
+    l = min(len(labels), self.max_label_length)
+    spec = np.zeros((self.max_time_steps, self.num_feature_bins, 1),
+                    np.float32)
+    spec[:t, :, 0] = feats[:t, :self.num_feature_bins]
+    lab = np.zeros((self.max_label_length,), np.int32)
+    lab[:l] = labels[:l]
+    return spec, lab, np.int32(t), np.int32(l)
+
+  def minibatches(self, dataset, subset: str):
+    stream = self._record_stream(dataset, subset)
+    pool = concurrent.futures.ThreadPoolExecutor(self.num_threads)
+    try:
+      while True:
+        records = []
+        for record in stream:
+          records.append(record)
+          if len(records) == self.batch_size:
+            break
+        if len(records) < self.batch_size:
+          return
+        futs = [pool.submit(self._parse_utterance, rec)
+                for rec in records]
+        results = [f.result() for f in futs]
+        yield (np.stack([r[0] for r in results]),
+               (np.stack([r[1] for r in results]),
+                np.asarray([r[2] for r in results], np.int32),
+                np.asarray([r[3] for r in results], np.int32)))
+    finally:
+      pool.shutdown(wait=False)
+
+
 class TestImagePreprocessor(InputPreprocessor):
   """Injects fake numpy data as "real" input (ref:
   preprocessing.py:896-975). ``set_fake_data`` then iterate."""
@@ -588,6 +645,7 @@ _PREPROCESSORS = {
     "imagenet": RecordInputImagePreprocessor,
     "cifar10": Cifar10ImagePreprocessor,
     "coco": COCOPreprocessor,
+    "librispeech": LibrispeechPreprocessor,
     "test": TestImagePreprocessor,
 }
 
