@@ -137,18 +137,31 @@ def load_checkpoint(path: str) -> dict:
     return serialization.msgpack_restore(f.read())
 
 
+def _reseed_staged(buffers, params):
+  """Point the staged-reads buffer at the (new) live params: after any
+  restore, the first forward must read the restored weights, not the
+  fresh-init ones the buffer was created from (--staged_vars; the
+  StagingArea warmup refill analog, variable_mgr_util.py:236-310)."""
+  if isinstance(buffers, dict) and "staged_params" in buffers:
+    buffers = dict(buffers)
+    buffers["staged_params"] = params
+  return buffers
+
+
 def restore_state(state, snapshot: dict):
   """Rebuild a stacked device TrainState from a host snapshot: replica-0
   values are broadcast to every replica (the restore-side analog of the
   reference's post-init v0->v* copy, variable_mgr.py:342-356)."""
+  params = _restack(state.params, snapshot["params"])
   return state.replace(
       step=jnp.asarray(snapshot["step"], jnp.int32),
-      params=_restack(state.params, snapshot["params"]),
+      params=params,
       opt_state=_restack(state.opt_state, snapshot["opt_state"]),
       batch_stats=_restack(state.batch_stats, snapshot["batch_stats"]),
       loss_scale=jnp.asarray(snapshot["loss_scale"], jnp.float32),
       loss_scale_normal_steps=jnp.asarray(
           snapshot["loss_scale_normal_steps"], jnp.int32),
+      buffers=_reseed_staged(state.buffers, params),
   )
 
 
@@ -202,9 +215,11 @@ def restore_backbone(state, path: str):
 
     return jax.tree_util.tree_map_with_path(rebuild, collection)
 
+  params = merge(state.params, snapshot.get("params"))
   new_state = state.replace(
-      params=merge(state.params, snapshot.get("params")),
-      batch_stats=merge(state.batch_stats, snapshot.get("batch_stats")))
+      params=params,
+      batch_stats=merge(state.batch_stats, snapshot.get("batch_stats")),
+      buffers=_reseed_staged(state.buffers, params))
   return new_state, restored[0]
 
 

@@ -196,6 +196,15 @@ flags.DEFINE_integer("adaptive_batch_max", 1024,
                      lower_bound=1)
 flags.DEFINE_boolean("cross_replica_sync", True,
                      "Synchronous data-parallel updates (ref :520-522).")
+flags.DEFINE_enum("variable_consistency", "strong", ("strong", "relaxed"),
+                  "relaxed applies one-step-stale gradients (double-"
+                  "buffered in the step carry; ref :242, "
+                  "batch_allreduce.py:353-388 deferred StagingArea "
+                  "gradients).")
+flags.DEFINE_boolean("staged_vars", False,
+                     "Forward/backward read one-step-stale weights while "
+                     "updates land on the live ones (ref :406, "
+                     "variable_mgr.py:246-274 StagedVariableGetter).")
 flags.DEFINE_string("train_dir", None,
                     "Checkpoint/summary directory (ref :585-588).")
 flags.DEFINE_integer("summary_verbosity", 0,

@@ -48,6 +48,14 @@ class TrainState:
   loss_scale: Any
   loss_scale_normal_steps: Any
   rng: Any
+  # Transient double-buffers for the staleness modes (SURVEY 7.4): the
+  # XLA analog of the reference's StagingAreas. Holds 'deferred_grads'
+  # under --variable_consistency=relaxed (ref: batch_allreduce.py:353-388
+  # one-step-stale gradients) and/or 'staged_params' under --staged_vars
+  # (ref: variable_mgr.py:246-274 staged variable reads). Not part of
+  # checkpoints: a restart warms up with zeros/fresh copies exactly like
+  # the reference's StagingArea warmup ops.
+  buffers: Any = flax.struct.field(default_factory=dict)
 
 
 def _is_batch_norm_param(path) -> bool:
@@ -104,7 +112,9 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
   state_specs = TrainState(
       step=P(), params=P(REPLICA_AXIS), opt_state=P(REPLICA_AXIS),
       batch_stats=P(REPLICA_AXIS), loss_scale=P(),
-      loss_scale_normal_steps=P(), rng=P())
+      loss_scale_normal_steps=P(), rng=P(), buffers=P(REPLICA_AXIS))
+  staged_vars = bool(getattr(params, "staged_vars", False))
+  relaxed = getattr(params, "variable_consistency", "strong") == "relaxed"
 
   def _squeeze(tree):
     return jax.tree.map(lambda x: jnp.squeeze(x, axis=0), tree)
@@ -127,6 +137,14 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
     model_params, opt_state, batch_stats = _init(rng, sample_images)
     stack = lambda t: jax.tree.map(
         lambda x: jnp.broadcast_to(x[None], (num_replicas,) + x.shape), t)
+    buffers = {}
+    if relaxed:
+      # Warmed up with zero gradients, like the reference's StagingArea
+      # warmup put (ref: batch_allreduce.py:357-359).
+      buffers["deferred_grads"] = stack(
+          jax.tree.map(jnp.zeros_like, model_params))
+    if staged_vars:
+      buffers["staged_params"] = stack(model_params)
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=stack(model_params),
@@ -134,7 +152,8 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
         batch_stats=stack(batch_stats),
         loss_scale=jnp.asarray(init_loss_scale, jnp.float32),
         loss_scale_normal_steps=jnp.zeros((), jnp.int32),
-        rng=rng)
+        rng=rng,
+        buffers=buffers)
 
   # -- train step -----------------------------------------------------------
 
@@ -142,6 +161,12 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
     model_params = _squeeze(state.params)
     opt_state = _squeeze(state.opt_state)
     batch_stats = _squeeze(state.batch_stats)
+    buffers = _squeeze(state.buffers)
+    # --staged_vars: forward/backward read one-step-stale weights while
+    # updates land on the live ones (ref: StagedVariableGetter,
+    # variable_mgr_util.py:313-393).
+    forward_params = (buffers["staged_params"] if staged_vars
+                      else model_params)
     replica_id = lax.axis_index(REPLICA_AXIS)
     step_rng = jax.random.fold_in(
         jax.random.fold_in(state.rng, state.step), replica_id)
@@ -165,7 +190,7 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
       return scaled, (base_loss, total_loss, new_bs, result)
 
     grads, (base_loss, total_loss, new_bs, net_result) = jax.grad(
-        loss_fn, has_aux=True)(model_params)
+        loss_fn, has_aux=True)(forward_params)
     if use_loss_scale or auto_loss_scale:
       grads = jax.tree.map(lambda g: g / state.loss_scale, grads)
     noise_stats = None
@@ -179,6 +204,34 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
           grads, REPLICA_AXIS, images.shape[0])
     grads = strategy.reduce_gradients(grads, REPLICA_AXIS)
 
+    def _all_finite(tree):
+      ok = jnp.all(jnp.stack(
+          [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(tree)]))
+      # Globally uniform decision (pmin across replicas) so every carried
+      # scalar stays replicated (ref chief-only NaN check + broadcast,
+      # variable_mgr.py:186-193).
+      return lax.pmin(ok.astype(jnp.int32), REPLICA_AXIS).astype(bool)
+
+    # The loss-scale state machine keys on THIS step's fresh gradients
+    # (they reflect the current scale), even when the applied gradients
+    # are the deferred ones (ref: variable_mgr_util.py:51-139).
+    fresh_finite = _all_finite(grads) if auto_loss_scale else None
+    new_buffers = dict(buffers)
+    if relaxed:
+      # --variable_consistency=relaxed: apply the PREVIOUS step's reduced
+      # gradients and bank this step's for the next -- the double-buffered
+      # reformulation of the reference's deferred StagingArea gradients
+      # (ref: batch_allreduce.py:353-388; SURVEY 7.4). Non-finite fresh
+      # gradients are never banked (the deferred analog of the skipped
+      # update): the old bank stays.
+      banked = grads
+      if fresh_finite is not None:
+        banked = jax.tree.map(
+            lambda a, b: jnp.where(fresh_finite, a, b),
+            grads, buffers["deferred_grads"])
+      new_buffers["deferred_grads"] = banked
+      grads = buffers["deferred_grads"]
+
     model_params_pre = strategy.pre_update(model_params, state.step,
                                            REPLICA_AXIS)
     updates, new_opt_state = tx.update(grads, opt_state, model_params_pre)
@@ -188,26 +241,24 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
 
     if auto_loss_scale:
       # Auto loss-scale state machine (ref: variable_mgr_util.py:51-139):
-      # any non-finite grad -> skip update, halve scale; else count a
-      # normal step and double the scale every ``inc_every_n``. The
-      # finite-decision is made globally (pmin across replicas) so the
-      # loss scale stays replicated under every strategy -- the analog of
-      # the reference's chief-only NaN check + broadcast decision
-      # (variable_mgr.py:186-193).
-      finite = jnp.all(jnp.stack(
-          [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)]))
-      finite = lax.pmin(finite.astype(jnp.int32), REPLICA_AXIS).astype(bool)
+      # any non-finite FRESH grad -> halve scale; else count a normal
+      # step and double the scale every ``inc_every_n``. The update skip
+      # keys on the gradients actually APPLIED (identical to fresh under
+      # strong consistency; the previous step's bank under relaxed).
+      applied_finite = (fresh_finite if not relaxed
+                        else _all_finite(grads))
       keep = lambda new, old: jax.tree.map(
-          lambda a, b: jnp.where(finite, a, b), new, old)
+          lambda a, b: jnp.where(applied_finite, a, b), new, old)
       new_params = keep(new_params, model_params)
       new_opt_state = keep(new_opt_state, opt_state)
       new_bs = keep(new_bs, batch_stats)
-      normal_steps = jnp.where(finite,
+      normal_steps = jnp.where(fresh_finite,
                                state.loss_scale_normal_steps + 1,
                                0)
-      do_double = jnp.logical_and(finite, normal_steps >= inc_every_n)
+      do_double = jnp.logical_and(fresh_finite,
+                                  normal_steps >= inc_every_n)
       new_scale = jnp.where(
-          finite,
+          fresh_finite,
           jnp.where(do_double, state.loss_scale * 2.0, state.loss_scale),
           jnp.maximum(state.loss_scale / 2.0, 1.0))
       normal_steps = jnp.where(do_double, 0, normal_steps)
@@ -231,6 +282,10 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
     if noise_stats is not None:
       metrics["noise_scale_g2"], metrics["noise_scale_s"] = noise_stats
 
+    if staged_vars:
+      # Next step's reads see this step's PRE-update weights: the value
+      # that was in the staging area at read time (one-step staleness).
+      new_buffers["staged_params"] = model_params
     new_state = TrainState(
         step=state.step + 1,
         params=_expand(new_params),
@@ -238,7 +293,8 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
         batch_stats=_expand(new_bs),
         loss_scale=new_scale,
         loss_scale_normal_steps=normal_steps,
-        rng=state.rng)
+        rng=state.rng,
+        buffers=_expand(new_buffers))
     return new_state, metrics
 
   # Models built on library-internal scans (optax ctc_loss, flax RNN)

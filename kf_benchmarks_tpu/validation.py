@@ -70,6 +70,23 @@ def validate_cross_flags(params) -> None:
   if p.fp16_enable_auto_loss_scale and not p.use_fp16:
     raise ParamError("--fp16_enable_auto_loss_scale requires --use_fp16 "
                      "(ref :1334-1336)")
+  if p.staged_vars and p.variable_update != "parameter_server":
+    raise ParamError("--staged_vars is only supported with "
+                     "--variable_update=parameter_server (ref :1478-1479)")
+  if p.staged_vars and p.fp16_enable_auto_loss_scale:
+    raise ParamError("Automatic loss scaling is not supported with "
+                     "--staged_vars (ref :1304-1305)")
+  if p.staged_vars and eval_during_training_enabled(p):
+    raise ParamError("--eval_during_training_* is not compatible with "
+                     "--staged_vars (ref :1335-1336)")
+  if p.variable_consistency == "relaxed" and p.variable_update not in (
+      "replicated", "distributed_replicated", "parameter_server",
+      "collective_all_reduce", "distributed_all_reduce"):
+    raise ParamError(
+        "--variable_consistency=relaxed requires a replicated-family "
+        "--variable_update (the deferral lives in the batched all-reduce, "
+        "ref: batch_allreduce.py:32-153; independent/kungfu/horovod "
+        "reduce outside it)")
   if (p.use_fp16 and p.fp16_enable_auto_loss_scale and
       p.variable_update not in ("parameter_server", "replicated",
                                 "independent", "kungfu")):

@@ -51,20 +51,22 @@ class MiniModel(Model):
     return {"top_1_accuracy": jnp.float32(0), "top_5_accuracy": jnp.float32(0)}
 
 
-def _make_step(strategy, mesh):
+def _make_step(strategy, mesh, **param_overrides):
   model = MiniModel()
   module = model.make_module(1, True)
   p = params_lib.make_params(weight_decay=0.0, optimizer="sgd",
-                             num_devices=N_REPLICAS, device="cpu")
+                             num_devices=N_REPLICAS, device="cpu",
+                             **param_overrides)
   tx = optax.sgd(LR)
   lr_fn = lambda step: jnp.float32(LR)
   return train_step_lib.make_step_fns(model, module, module, strategy, tx,
                                       lr_fn, p, mesh)
 
 
-def _run(strategy, steps=5):
+def _run(strategy, steps=5, **param_overrides):
   mesh = build_mesh(N_REPLICAS, "cpu")
-  init_state, train_step, _, broadcast_init = _make_step(strategy, mesh)
+  init_state, train_step, _, broadcast_init = _make_step(
+      strategy, mesh, **param_overrides)
   # Per-replica scalar inputs x_i = i+1, labels y_i = 2*(i+1).
   x = jnp.arange(1, N_REPLICAS + 1, dtype=jnp.float32).reshape(N_REPLICAS, 1)
   y = 2.0 * jnp.arange(1, N_REPLICAS + 1, dtype=jnp.float32)
@@ -105,6 +107,104 @@ def _manual(mode, steps=5, w0=0.5):
     else:
       raise ValueError(mode)
   return losses, w
+
+
+def _manual_relaxed(steps=5, w0=0.5):
+  """Hand-rolled one-step-stale loop: step t applies the replica-mean
+  gradient COMPUTED at step t-1 (zero at t=0) -- the staleness must be
+  visible here for the equivalence test to mean anything
+  (ref: batch_allreduce.py:353-388 deferred gradients)."""
+  x = np.arange(1, N_REPLICAS + 1, dtype=np.float64)
+  y = 2.0 * x
+  w = np.full(N_REPLICAS, w0)
+  banked = np.zeros(N_REPLICAS)
+  losses = []
+  for t in range(steps):
+    per_replica_loss = (w * x - y) ** 2
+    losses.append(per_replica_loss.mean())
+    g = 2 * x * (w * x - y)
+    g = np.full(N_REPLICAS, g.mean())
+    w = w - LR * banked  # apply the PREVIOUS step's gradients
+    banked = g
+  return losses, w
+
+
+def _manual_staged(steps=5, w0=0.5):
+  """Hand-rolled staged-reads loop: gradients evaluate at the weights
+  from BEFORE the previous update; updates land on the live weights
+  (ref: variable_mgr.py:246-274 staged PS variables)."""
+  x = np.arange(1, N_REPLICAS + 1, dtype=np.float64)
+  y = 2.0 * x
+  w = np.full(N_REPLICAS, w0)
+  stale = w.copy()
+  losses = []
+  for t in range(steps):
+    per_replica_loss = (stale * x - y) ** 2  # forward reads stale weights
+    losses.append(per_replica_loss.mean())
+    g = 2 * x * (stale * x - y)
+    g = np.full(N_REPLICAS, g.mean())
+    stale = w.copy()  # the staging area refills with the pre-update value
+    w = w - LR * g
+  return losses, w
+
+
+def test_relaxed_consistency_matches_manual_stale_loop():
+  p = params_lib.make_params(variable_update="replicated",
+                             variable_consistency="relaxed",
+                             num_devices=N_REPLICAS, device="cpu")
+  losses, w = _run(strategies.get_strategy(p), steps=5,
+                   variable_consistency="relaxed")
+  want_losses, want_w = _manual_relaxed(steps=5)
+  np.testing.assert_allclose(losses, want_losses, rtol=1e-5)
+  np.testing.assert_allclose(w, want_w, rtol=1e-5)
+  # And the staleness is real: strong-consistency losses differ.
+  strong_losses, _ = _manual("replicated", steps=5)
+  assert not np.allclose(losses[1:], strong_losses[1:])
+
+
+def test_staged_vars_matches_manual_staged_loop():
+  p = params_lib.make_params(variable_update="parameter_server",
+                             staged_vars=True,
+                             num_devices=N_REPLICAS, device="cpu")
+  losses, w = _run(strategies.get_strategy(p), steps=5, staged_vars=True)
+  want_losses, want_w = _manual_staged(steps=5)
+  np.testing.assert_allclose(losses, want_losses, rtol=1e-5)
+  np.testing.assert_allclose(w, want_w, rtol=1e-5)
+  strong_losses, _ = _manual("replicated", steps=5)
+  assert not np.allclose(losses[1:], strong_losses[1:])
+
+
+def test_staged_buffer_reseeded_on_restore():
+  """Resume must not leave the staged-reads buffer at fresh-init values
+  while the live params are restored (a garbage first gradient would be
+  applied to the trained weights otherwise)."""
+  from kf_benchmarks_tpu import checkpoint
+  p = params_lib.make_params(variable_update="parameter_server",
+                             staged_vars=True,
+                             num_devices=N_REPLICAS, device="cpu")
+  mesh = build_mesh(N_REPLICAS, "cpu")
+  init_state, train_step, _, _ = _make_step(
+      strategies.get_strategy(p), mesh, staged_vars=True)
+  x = jnp.ones((N_REPLICAS, 1), jnp.float32)
+  state = jax.jit(init_state)(jax.random.PRNGKey(0), x[:1])
+  from flax import serialization
+  snapshot = serialization.to_state_dict(checkpoint.savable_state(state))
+  snapshot["params"]["w"] = np.full((1, 1), 7.25, np.float32)
+  restored = checkpoint.restore_state(state, snapshot)
+  np.testing.assert_allclose(
+      np.asarray(restored.buffers["staged_params"]["w"]).ravel(),
+      np.full(N_REPLICAS, 7.25))
+
+
+def test_staleness_flag_validation():
+  import pytest
+  from kf_benchmarks_tpu import validation
+  with pytest.raises(validation.ParamError, match="staged_vars"):
+    validation.validate_cross_flags(params_lib.make_params(
+        staged_vars=True, variable_update="replicated"))
+  with pytest.raises(validation.ParamError, match="relaxed"):
+    validation.validate_cross_flags(params_lib.make_params(
+        variable_consistency="relaxed", variable_update="kungfu"))
 
 
 @pytest.mark.parametrize("vu,mode", [
