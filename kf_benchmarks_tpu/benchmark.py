@@ -285,10 +285,12 @@ class BenchmarkCNN:
     if jnp.issubdtype(images.dtype, jnp.floating):
       images = images.astype(self.compute_dtype)
     # Labels may be a pytree (e.g. SSD's (boxes, classes, num_matched)).
+    # Tile covers THIS process's devices; put_batch assembles the global
+    # array from per-process shards under multi-process SPMD.
     tile = lambda x: jnp.tile(x, (self.num_devices,) + (1,) * (x.ndim - 1))
     batch_sharding = mesh_lib.batch_sharding(self.mesh)
-    put = lambda x: jax.device_put(x, batch_sharding)
-    return (put(tile(images)), jax.tree.map(lambda l: put(tile(l)), labels))
+    return mesh_lib.put_batch(
+        (tile(images), jax.tree.map(tile, labels)), batch_sharding)
 
   def _input_iterator(self, rng, subset: str = "train"):
     """Per-step input source.
@@ -415,8 +417,7 @@ class BenchmarkCNN:
     init_state, train_step, eval_step, broadcast_init = self._build()
     next_batch = self._open_input(self._data_rng, "train")
     shape = (batch_per_device,) + self._model_image_shape()
-    new_state = jax.jit(init_state)(init_rng,
-                                    jnp.zeros(shape, jnp.float32))
+    new_state = init_state(init_rng, jnp.zeros(shape, jnp.float32))
     new_state = checkpoint.restore_state(new_state, snapshot)
     new_state = new_state.replace(
         params=broadcast_init(new_state.params))
@@ -433,10 +434,9 @@ class BenchmarkCNN:
     replicated = mesh_lib.replicated_sharding(self.mesh)
     log_fn("Generating training model")
     t0 = time.time()
-    state = jax.jit(
-        init_state,
-        static_argnums=(),
-        out_shardings=None)(init_rng, jnp.zeros(sample.shape, sample.dtype))
+    # init_state is already jitted with explicit state shardings
+    # (train_step.make_step_fns).
+    state = init_state(init_rng, jnp.zeros(sample.shape, sample.dtype))
     # Resume from the newest checkpoint if the train_dir has one; the run
     # then executes num_batches MORE steps from the restored global step
     # (ref: Supervisor auto-restore, benchmark_cnn.py:2122-2157).
@@ -799,7 +799,7 @@ class BenchmarkCNN:
     rng = jax.random.PRNGKey(p.tf_random_seed or 0)
     data_rng, init_rng = jax.random.split(rng)
     shape = self._model_image_shape()
-    state = jax.jit(init_state)(
+    state = init_state(
         init_rng, jnp.zeros((self.batch_size_per_device,) + shape,
                             jnp.float32))
     # Detection (and other accumulate-then-postprocess) models own their

@@ -66,11 +66,22 @@ class JaxClusterManager(BaseClusterManager):
     self._initialized = False
     workers = self._cluster_spec["worker"]
     if len(workers) > 1:
+      import os
       import jax
+      if params.device == "cpu":
+        # Cross-process CPU collectives need an explicit backend; gloo
+        # ships with jaxlib (the CPU stand-in for TPU ICI collectives,
+        # SURVEY 5.8 comm-backend table).
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+      # Under kfrun each worker gets its rank via env (the same command
+      # line is launched N times; ref: kungfu-run peer-list env
+      # propagation, SURVEY 2.9).
+      task_index = int(os.environ.get("KFCOORD_RANK_HINT",
+                                      params.task_index))
       jax.distributed.initialize(
           coordinator_address=workers[0],
           num_processes=len(workers),
-          process_id=params.task_index)
+          process_id=task_index)
       self._initialized = True
 
   def join_server(self):

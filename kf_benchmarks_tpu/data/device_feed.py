@@ -17,6 +17,8 @@ from typing import Iterator, Optional, Tuple
 
 import jax
 
+from kf_benchmarks_tpu.parallel import mesh as mesh_lib
+
 
 class DeviceFeeder:
   """Prefetching device-transfer iterator (depth-``prefetch`` pipeline)."""
@@ -43,8 +45,7 @@ class DeviceFeeder:
           batch = next(it)
         except StopIteration:
           break
-        device_batch = jax.tree.map(
-            lambda x: jax.device_put(x, self._sharding), batch)
+        device_batch = mesh_lib.put_batch(batch, self._sharding)
         while not self._stop.is_set():
           try:
             self._queue.put(device_batch, timeout=0.5)

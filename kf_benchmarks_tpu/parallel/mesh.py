@@ -20,26 +20,54 @@ REPLICA_AXIS = "replica"
 
 
 def get_devices(device_kind: str = "tpu", num_devices: Optional[int] = None):
-  """Resolve the local device list (ref: benchmark_cnn.py:1419-1426)."""
+  """Resolve the device list (ref: benchmark_cnn.py:1419-1426).
+
+  ``num_devices`` counts devices PER PROCESS (the reference's
+  one-process-per-GPU num_gpus); under multi-process SPMD the mesh spans
+  every process's devices, so the resolved list is global."""
   devices = jax.devices()
   if device_kind == "cpu":
     cpus = [d for d in devices if d.platform == "cpu"]
     devices = cpus or devices
   if num_devices is not None:
-    if num_devices > len(devices):
-      raise ValueError(
-          f"Requested {num_devices} devices but only {len(devices)} "
-          f"available ({[str(d) for d in devices]})")
-    devices = devices[:num_devices]
+    # Take the first num_devices of EACH process's devices (a global
+    # prefix could exclude some processes entirely, leaving them with no
+    # addressable shard of the mesh).
+    by_proc = {}
+    for d in devices:
+      by_proc.setdefault(d.process_index, []).append(d)
+    picked = []
+    for pid in sorted(by_proc):
+      if len(by_proc[pid]) < num_devices:
+        raise ValueError(
+            f"Requested {num_devices} devices per process but process "
+            f"{pid} has only {len(by_proc[pid])} "
+            f"({[str(d) for d in by_proc[pid]]})")
+      picked.extend(by_proc[pid][:num_devices])
+    devices = picked
   return devices
 
 
 def build_mesh(num_devices: Optional[int] = None, device_kind: str = "tpu",
                devices: Optional[Sequence] = None) -> Mesh:
-  """1-D data-parallel mesh over the replica axis."""
+  """1-D data-parallel mesh over the replica axis (global under
+  multi-process SPMD)."""
   if devices is None:
     devices = get_devices(device_kind, num_devices)
   return Mesh(np.asarray(devices), (REPLICA_AXIS,))
+
+
+def put_batch(batch, sharding: NamedSharding):
+  """Host batch -> device, sharded over the batch axis. Single-process:
+  a plain device_put. Multi-process: each process contributes the shard
+  for ITS devices (jax.make_array_from_process_local_data), the
+  jax-native form of the reference's per-worker input splits
+  (ref: preprocessing shift_ratio sharding + per-device StagingAreas)."""
+  if jax.process_count() > 1:
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(
+            sharding, np.asarray(x)), batch)
+  return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:

