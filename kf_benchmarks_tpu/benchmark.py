@@ -363,7 +363,70 @@ class BenchmarkCNN:
     self.print_info()
     if self.params.eval:
       return self._run_eval()
+    if self.params.forward_only and self.params.aot_load_path:
+      return self._benchmark_aot_serving()
     return self._benchmark_train()
+
+  def _benchmark_aot_serving(self) -> Dict[str, Any]:
+    """Serving benchmark on a frozen AOT artifact: deserialize the
+    exported forward program (weights baked in as constants) in THIS
+    process and time it -- the analog of benchmarking the
+    TensorRT-converted graph (ref: _preprocess_graph freeze+convert,
+    benchmark_cnn.py:2405-2525, timed by the forward-only loop)."""
+    from kf_benchmarks_tpu import aot
+    p = self.params
+    serving_fn = aot.load_forward(p.aot_load_path)
+    log_fn(f"Loaded frozen forward program from {p.aot_load_path}")
+    shape = (self.batch_size_per_device,) + self._model_image_shape()
+    images = jax.random.uniform(jax.random.PRNGKey(p.tf_random_seed or 0),
+                                shape, jnp.float32)
+    jax.block_until_ready(images)
+    log_fn("Running warm up")
+    t0 = time.time()
+    for _ in range(max(self.num_warmup_batches, 1)):
+      out = serving_fn(images)
+    jax.block_until_ready(out)
+    log_fn("Warmup (load + %d steps): %.1f s" %
+           (max(self.num_warmup_batches, 1), time.time() - t0))
+    log_fn("Step\tImg/sec\t" + p.loss_type_to_report)
+    step_times = []
+    last_display_len = 0
+    pipe = pipeline_lib.MetricsPipeline(lag=2)
+    pipe.reset_clock()
+
+    def _handle(done):
+      nonlocal last_display_len
+      step_times.append(done.interval)
+      i1 = done.index
+      if i1 % self.display_every == 0 or i1 == self.num_batches:
+        window = step_times[last_display_len:]
+        # The artifact returns logits only; the loss column reports the
+        # mean logit as a liveness value (no labels in serving).
+        log_fn(log_util.format_step_line(
+            i1, self.batch_size_per_device, window,
+            float(done.metrics["mean_logit"])))
+        last_display_len = len(step_times)
+
+    loop_start = time.time()
+    for i in range(self.num_batches):
+      out = serving_fn(images)
+      for done in pipe.push(i + 1, {"mean_logit": jnp.mean(out)}):
+        _handle(done)
+    for done in pipe.flush():
+      _handle(done)
+    total_time = time.time() - loop_start
+    images_per_sec = (self.num_batches * self.batch_size_per_device /
+                      max(total_time, 1e-9))
+    log_fn("-" * 64)
+    log_fn("total images/sec: %.2f" % images_per_sec)
+    log_fn("-" * 64)
+    return {
+        "num_workers": 1,
+        "num_steps": self.num_batches,
+        "average_wall_time": total_time / max(self.num_batches, 1),
+        "images_per_sec": images_per_sec,
+        "aot_load_path": p.aot_load_path,
+    }
 
   def _benchmark_train(self) -> Dict[str, Any]:
     p = self.params

@@ -248,11 +248,16 @@ class SSD300Model(model_lib.CNNModel):
     from kf_benchmarks_tpu.parallel import mesh as mesh_lib
     self.params = params  # postprocess reads data_dir for annotations
     module = self.make_module(self.label_num, phase_train=False)
-    # Global batch sharded over the mesh: detection eval is embarrassingly
-    # batch-parallel, so it uses every device like the shared eval loop.
+    # Batch sharded over THIS process's devices: detection eval is
+    # embarrassingly batch-parallel within a process; under multi-process
+    # SPMD each process evaluates the full validation set redundantly on
+    # its local mesh (identical results everywhere, no cross-process
+    # arrays to gather; the chief's report is the one consumed).
     num_devices = max(getattr(params, "num_devices", 1) or 1, 1)
     batch = self.get_batch_size() * num_devices
-    mesh = mesh_lib.build_mesh(num_devices, params.device)
+    local = [d for d in jax.local_devices()
+             if params.device != "cpu" or d.platform == "cpu"]
+    mesh = mesh_lib.build_mesh(devices=local[:num_devices])
     batch_sharding = mesh_lib.batch_sharding(mesh)
     variables = jax.device_put(variables,
                                mesh_lib.replicated_sharding(mesh))

@@ -128,6 +128,15 @@ class KungFuStrategy(Strategy):
                  gossip (ppermute), reformulated synchronous (SURVEY 7.4)
     sma       -- SynchronousAveragingOptimizer: average weights, then
                  local gradient step
+
+  Throughput semantics under async_sgd/sma: AD-PSGD's asynchrony does
+  not exist under SPMD -- every replica executes the same step in
+  lockstep, so a "global step" is one synchronized step of all replicas
+  and the standard window math applies unchanged. The reference's
+  GlobalStepWatcher (ref: benchmark_cnn.py:639-684), which existed to
+  measure true global-step rate when replicas advanced independently,
+  has nothing to measure here by construction; the asynchrony is
+  reformulated into the deterministic gossip schedule, not the timing.
   """
 
   name = "kungfu"

@@ -240,6 +240,12 @@ flags.DEFINE_string("aot_save_path", None,
                     "path -- the serving-graph/TensorRT analog "
                     "(ref trt_mode :615-620, _preprocess_graph "
                     ":2405-2525).")
+flags.DEFINE_string("aot_load_path", None,
+                    "Forward-only mode: load a frozen forward program "
+                    "exported via --aot_save_path and benchmark ITS "
+                    "images/sec -- the serving benchmark on the frozen "
+                    "artifact (ref: the TRT-converted-graph timing path, "
+                    "_preprocess_graph + forward-only loop).")
 flags.DEFINE_boolean("use_synthetic_gpu_images", False,
                      "(parity alias; synthetic data is data_dir=None)")
 # Distributed / cluster flags (ref :570-583).

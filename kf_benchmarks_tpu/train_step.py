@@ -245,15 +245,15 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
       # count a normal step and double the scale every ``inc_every_n``.
       # Under relaxed consistency the APPLIED gradients are the previous
       # bank, which only ever admits finite values (banking gate above),
-      # so the update skip is unnecessary there by induction -- skipping
-      # on fresh_finite under strong consistency is the reference
-      # semantics, and relaxed never needs the where-selects.
+      # so the params/opt_state skip is unnecessary there by induction.
+      keep = lambda new, old: jax.tree.map(
+          lambda a, b: jnp.where(fresh_finite, a, b), new, old)
       if not relaxed:
-        keep = lambda new, old: jax.tree.map(
-            lambda a, b: jnp.where(fresh_finite, a, b), new, old)
         new_params = keep(new_params, model_params)
         new_opt_state = keep(new_opt_state, opt_state)
-        new_bs = keep(new_bs, batch_stats)
+      # batch_stats come from THIS step's forward in both modes: an
+      # overflowing forward must not poison the running statistics.
+      new_bs = keep(new_bs, batch_stats)
       normal_steps = jnp.where(fresh_finite,
                                state.loss_scale_normal_steps + 1,
                                0)

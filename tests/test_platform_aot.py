@@ -94,3 +94,37 @@ def test_aot_export_roundtrip(tmp_path):
   live_logits, _ = module.apply(variables, jnp.asarray(images))
   np.testing.assert_allclose(np.asarray(logits), np.asarray(live_logits),
                              rtol=1e-5, atol=1e-5)
+
+
+def test_aot_serving_benchmark_fresh_process(tmp_path):
+  """--forward_only --aot_load_path times the frozen artifact in a FRESH
+  process (VERDICT r1 next #10: the TRT-serving-benchmark analog,
+  ref: _preprocess_graph benchmark_cnn.py:2405-2525)."""
+  import os
+  import re
+  import subprocess
+  import sys
+  repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+  path = str(tmp_path / "frozen_forward.bin")
+  env = dict(os.environ)
+  env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+  env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+  common = [sys.executable, "-m", "kf_benchmarks_tpu.cli",
+            "--model=trivial", "--forward_only=true", "--device=cpu",
+            "--batch_size=4", "--num_warmup_batches=1"]
+  # 1) Export the frozen forward program.
+  save = subprocess.run(
+      common + ["--num_batches=2", f"--aot_save_path={path}"],
+      env=env, cwd=repo, capture_output=True, text=True, timeout=300)
+  assert save.returncode == 0, (save.stdout, save.stderr)
+  assert "Exported frozen forward program" in save.stdout
+  assert os.path.getsize(path) > 0
+  # 2) A fresh process loads and times it.
+  load = subprocess.run(
+      common + ["--num_batches=6", f"--aot_load_path={path}"],
+      env=env, cwd=repo, capture_output=True, text=True, timeout=300)
+  assert load.returncode == 0, (load.stdout, load.stderr)
+  assert "Loaded frozen forward program" in load.stdout
+  m = re.search(r"total images/sec: ([\d.]+)", load.stdout)
+  assert m, load.stdout
+  assert float(m.group(1)) > 0
