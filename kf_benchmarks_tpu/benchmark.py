@@ -105,27 +105,52 @@ def feeder_prefetch(params) -> int:
 # ineffective flag was a round-1 defect); flags with real consumers never
 # belong here.
 _NOOP_PARITY_FLAGS = {
-    "winograd_nonfused": (
-        True, "cuDNN autotune env knob; no TPU analog (ref :3285-3297)"),
-    "gpu_memory_frac_for_testing": (
-        0.0, "per-process GPU memory split for tests; TPU memory is not "
-        "fractionally reservable (ref :336-342)"),
-    "network_topology": (
-        0, "GPU box topology table index; the TPU mesh topology comes "
-        "from the runtime (ref constants.py:21-24)"),
-    "sparse_to_dense_grads": (
-        False, "JAX gradients are already dense (ref :518-519)"),
-    "allreduce_merge_scope": (
-        1, "ScopedAllocator merge hint; XLA schedules collectives itself "
-        "(ref :561-566)"),
-    "server_protocol": (
-        "grpc", "the coordination service speaks its own protocol "
-        "(ref :578)"),
+    "winograd_nonfused": ("cuDNN autotune env knob; no TPU analog (ref :3285-3297)"),
+    "gpu_memory_frac_for_testing": ("per-process GPU memory split for tests; TPU memory is not " "fractionally reservable (ref :336-342)"),
+    "network_topology": ("GPU box topology table index; the TPU mesh topology comes " "from the runtime (ref constants.py:21-24)"),
+    "sparse_to_dense_grads": ("JAX gradients are already dense (ref :518-519)"),
+    "allreduce_merge_scope": ("ScopedAllocator merge hint; XLA schedules collectives itself " "(ref :561-566)"),
+    "server_protocol": ("the coordination service speaks its own protocol " "(ref :578)"),
+    "trt_max_workspace_size_bytes": ("TensorRT knob"),
+    "use_chrome_trace_format": ("jax.profiler writes its own " "trace format"),
+    "xla": ("XLA is the only execution path on TPU"),
+    "xla_compile": ("the whole step is always jitted"),
+    "freeze_when_forward_only": ("freezing IS the AOT export; " "use --aot_save_path"),
+    "fuse_decode_and_crop": ("the host pipeline always crops " "before resizing"),
+    "distort_color_in_yiq": ("color jitter runs via PIL " "enhancers"),
+    "datasets_use_prefetch": ("the DeviceFeeder always prefetches"),
+    "datasets_parallel_interleave_cycle_length": ("shard reads interleave via the thread pool"),
+    "datasets_sloppy_parallel_interleave": ("tf.data knob"),
+    "datasets_parallel_interleave_prefetch": ("tf.data knob"),
+    "use_multi_device_iterator": ("the DeviceFeeder is the " "MultiDeviceIterator analog"),
+    "multi_device_iterator_max_buffer_size": ("MultiDeviceIterator " "knob"),
+    "use_resource_vars": ("JAX state is functional"),
+    "use_tf_layers": ("one flax layer path"),
+    "use_python32_barrier": ("CPython barrier workaround"),
+    "compute_lr_on_cpu": ("the LR schedule is fused into the " "jitted step"),
+    "enable_optimizations": ("XLA optimizations are always on"),
+    "rewriter_config": ("grappler knob"),
+    "allow_growth": ("GPU memory knob"),
+    "force_gpu_compatible": ("GPU pinned-memory knob"),
+    "gpu_indices": ("GPU ring-order indices"),
+    "gpu_thread_mode": ("GPU thread pools"),
+    "per_gpu_thread_count": ("GPU thread pools"),
+    "use_unified_memory": ("CUDA unified memory"),
+    "batchnorm_persistent": ("cuDNN batchnorm knob"),
+    "autotune_threshold": ("cuDNN autotune"),
+    "horovod_device": ("the SPMD data plane covers device pinning"),
+    "mkl": ("MKL build knob"),
+    "kmp_blocktime": ("MKL env var"),
+    "kmp_affinity": ("MKL env var"),
+    "kmp_settings": ("MKL env var"),
 }
 
 
 def report_noop_parity_flags(params) -> None:
-  for name, (default, why) in _NOOP_PARITY_FLAGS.items():
+  from kf_benchmarks_tpu import flags as flags_lib
+  for name, why in _NOOP_PARITY_FLAGS.items():
+    spec = flags_lib.param_specs.get(name)
+    default = spec.default_value if spec is not None else None
     if getattr(params, name, default) != default:
       log_fn(f"Note: --{name} is accepted for reference-CLI parity but "
              f"has no effect on TPU: {why}")
@@ -321,7 +346,8 @@ class BenchmarkCNN:
                 7919 * getattr(self, "_input_incarnation", 0)),
           shift_ratio=(kungfu.current_rank() /
                        max(kungfu.current_cluster_size(), 1)),
-          num_threads=p.datasets_num_private_threads or 8)
+          num_threads=p.datasets_num_private_threads or 8,
+          repeat_cached_sample=bool(p.datasets_repeat_cached_sample))
       if hasattr(pre, "max_label_length"):
         # Speech: label padding must match the model's static label slot.
         pre.max_label_length = getattr(self.model, "max_label_length",
@@ -567,8 +593,8 @@ class BenchmarkCNN:
     if p.train_dir and p.save_summaries_steps and p.summary_verbosity:
       summary_writer = observability.SummaryWriter(p.train_dir,
                                                    p.summary_verbosity)
-    if p.graph_file or p.tfprof_file:
-      # One lowering feeds both dumps (tracing a big model twice is
+    if p.graph_file or p.tfprof_file or p.partitioned_graph_file_prefix:
+      # One lowering feeds all dumps (tracing a big model twice is
       # minutes of redundant startup work). Forward-only dumps the eval
       # program it actually runs.
       dump_fn = eval_step if p.forward_only else train_step
@@ -576,11 +602,20 @@ class BenchmarkCNN:
       if p.graph_file:
         observability.dump_program_text(lowered, p.graph_file)
         log_fn(f"Wrote program text to {p.graph_file}")
+      # The compiled dumps share ONE compilation.
+      compiled = (lowered.compile()
+                  if p.tfprof_file or p.partitioned_graph_file_prefix
+                  else None)
       if p.tfprof_file:
-        observability.dump_cost_analysis(lowered, p.tfprof_file)
+        observability.dump_cost_analysis(lowered, p.tfprof_file,
+                                         compiled=compiled)
         log_fn("Wrote cost analysis to %s (note: the analysis compiles "
                "the step once ahead of the jit cache's own compile)"
                % p.tfprof_file)
+      if p.partitioned_graph_file_prefix:
+        path = p.partitioned_graph_file_prefix + ".txt"
+        observability.dump_partitioned_text(compiled, path)
+        log_fn(f"Wrote partitioned program text to {path}")
 
     # Elastic / adaptive-batch drivers (north-star KungFu capabilities;
     # see elastic.py).
@@ -834,6 +869,13 @@ class BenchmarkCNN:
     log_fn("Accuracy @ 1 = %.4f Accuracy @ 5 = %.4f [%d examples]" %
            (top1, top5, evaluated * self.batch_size))
     eval_ips = evaluated * self.batch_size / max(elapsed, 1e-9)
+    if p.eval and p.eval_dir:
+      # Eval summary stream (ref: --eval_dir FileWriter,
+      # benchmark_cnn.py:585-586, :1770-1772).
+      observability.SummaryWriter(p.eval_dir, 1).write_scalars(
+          int(state.step), {"eval_top_1_accuracy": top1,
+                            "eval_top_5_accuracy": top5,
+                            "eval_images_per_sec": eval_ips})
     if p.benchmark_log_dir:
       # Eval-result emission (ref: benchmark_cnn.py:1915-1922). The
       # state's step is the restored checkpoint's global step, so

@@ -204,7 +204,8 @@ class InputPreprocessor:
   def __init__(self, batch_size: int, output_shape: Sequence[int],
                train: bool = True, distortions: bool = False,
                resize_method: str = "bilinear", seed: int = 301,
-               shift_ratio: float = 0.0, num_threads: int = 8):
+               shift_ratio: float = 0.0, num_threads: int = 8,
+               repeat_cached_sample: bool = False):
     self.batch_size = batch_size
     self.height, self.width, self.depth = output_shape
     self.train = train
@@ -213,6 +214,10 @@ class InputPreprocessor:
     self.seed = seed
     self.shift_ratio = shift_ratio
     self.num_threads = max(1, num_threads)
+    # --datasets_repeat_cached_sample: serve the first record forever to
+    # emulate memory-speed IO (ref: preprocessing create_dataset
+    # take(1).cache().repeat(), :879-882).
+    self.repeat_cached_sample = repeat_cached_sample
 
   def minibatches(self, dataset, subset: str) -> Iterator[
       Tuple[np.ndarray, np.ndarray]]:
@@ -227,6 +232,14 @@ class InputPreprocessor:
     shards = tfrecord.list_shards(dataset.data_dir, subset)
     shift = int(len(shards) * self.shift_ratio) % max(len(shards), 1)
     shards = shards[shift:] + shards[:shift]
+    if self.repeat_cached_sample:
+      first = next(iter(tfrecord.read_records(shards[0])), None)
+      if first is None:
+        raise ValueError(
+            f"datasets_repeat_cached_sample: first shard {shards[0]} "
+            "contains no records")
+      while True:
+        yield first
     rng = random.Random(self.seed)
     while True:
       order = list(shards)

@@ -69,15 +69,26 @@ def dump_program_text(lowered, path: str) -> None:
     f.write(lowered.as_text())
 
 
-def dump_cost_analysis(lowered, path: str) -> Dict[str, Any]:
+def dump_partitioned_text(compiled, path: str) -> None:
+  """Post-SPMD-partitioning program text of a compiled step (the
+  per-device partitioned GraphDef analog, ref: benchmark_cnn.py:293-296,
+  :869-883). Takes an already-compiled object so callers compile once."""
+  os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+  with open(path, "w") as f:
+    f.write(compiled.as_text())
+
+
+def dump_cost_analysis(lowered, path: str,
+                       compiled=None) -> Dict[str, Any]:
   """Compiled-HLO cost + memory analysis (the tfprof analog,
   ref: benchmark_cnn.py:276-289, :1208-1228 top-20 by accelerator time).
 
-  Takes the result of ``jit.lower(...)``; writes a JSON report and
-  returns it. Keys depend on the backend; flops and bytes-accessed are
-  present on CPU and TPU.
+  Takes the result of ``jit.lower(...)`` (and optionally its
+  already-compiled object, so callers needing several compiled dumps pay
+  one compilation); writes a JSON report and returns it. Keys depend on
+  the backend; flops and bytes-accessed are present on CPU and TPU.
   """
-  compiled = lowered.compile()
+  compiled = compiled if compiled is not None else lowered.compile()
   report: Dict[str, Any] = {}
   try:
     cost = compiled.cost_analysis()
@@ -124,6 +135,10 @@ class BenchmarkLogger:
     info = {
         "model_name": model_name,
         "dataset": {"name": dataset_name},
+        # (ref: --benchmark_test_id threading into the model-garden
+        # logger's run info, benchmark_cnn.py:344-348)
+        **({"test_id": params.benchmark_test_id}
+           if getattr(params, "benchmark_test_id", None) else {}),
         "machine_config": {"num_devices": num_devices,
                            "platform": jax.devices()[0].platform},
         "batch_size": batch_size,

@@ -298,6 +298,111 @@ flags.DEFINE_enum("loss_type_to_report", "total_loss",
                   ("base_loss", "total_loss"),
                   "Which loss the step line prints (ref :346-353).")
 
+# -- Reference-CLI parity corpus ---------------------------------------------
+# The remaining reference flags, so its command lines parse here. Wired
+# ones say so; the rest are accepted no-ops (changing them from their
+# defaults logs a note at setup -- benchmark._NOOP_PARITY_FLAGS) or are
+# rejected in validation with the TPU-native alternative named.
+flags.DEFINE_boolean("datasets_repeat_cached_sample", False,
+                     "Repeat the first input sample forever to emulate "
+                     "memory-speed IO (wired into the record stream; "
+                     "ref :259-263).")
+flags.DEFINE_string("benchmark_test_id", None,
+                    "Test id attached to the benchmark-log run info "
+                    "(wired; ref :344-348).")
+flags.DEFINE_string("eval_dir", "/tmp/tf_cnn_benchmarks/eval",
+                    "Directory for eval benchmark logs (wired; "
+                    "ref :585-586).")
+flags.DEFINE_string("partitioned_graph_file_prefix", None,
+                    "Dump the compiled (partitioned) program text to "
+                    "<prefix>.txt (wired; ref :293-296 per-device "
+                    "GraphDef dumps).")
+flags.DEFINE_string("debugger", None,
+                    "tfdbg has no TPU analog; any value is rejected "
+                    "(ref :370-377).")
+flags.DEFINE_string("trt_mode", "",
+                    "TensorRT conversion has no TPU analog; non-empty "
+                    "values are rejected -- use --aot_save_path, the "
+                    "XLA-native frozen-serving path (ref :615-620).")
+flags.DEFINE_boolean("freeze_when_forward_only", False,
+                     "Accepted for parity: freezing IS the AOT export "
+                     "(--aot_save_path folds weights into constants; "
+                     "ref :155-157).")
+flags.DEFINE_integer("trt_max_workspace_size_bytes", 4 << 30,
+                     "No-op on TPU (TensorRT knob, ref :619-620).")
+flags.DEFINE_boolean("use_chrome_trace_format", True,
+                     "No-op: jax.profiler writes its own trace format "
+                     "(ref :271-275).")
+flags.DEFINE_boolean("xla", False,
+                     "No-op: XLA is the only execution path on TPU "
+                     "(ref :413).")
+flags.DEFINE_boolean("xla_compile", False,
+                     "No-op: the whole step is always jitted "
+                     "(ref :414-416).")
+flags.DEFINE_boolean("fuse_decode_and_crop", True,
+                     "No-op: the host pipeline always crops before the "
+                     "expensive resize (ref :227-230).")
+flags.DEFINE_boolean("distort_color_in_yiq", True,
+                     "No-op: color jitter runs via PIL enhancers, not "
+                     "the YIQ rotation (ref :231-234).")
+flags.DEFINE_boolean("datasets_use_prefetch", True,
+                     "No-op: the DeviceFeeder always prefetches "
+                     "(ref :243-247).")
+flags.DEFINE_integer("datasets_parallel_interleave_cycle_length", None,
+                     "No-op: shard reads interleave via the thread pool "
+                     "(ref :264-266).")
+flags.DEFINE_boolean("datasets_sloppy_parallel_interleave", False,
+                     "No-op (tf.data interleave knob, ref :267-269).")
+flags.DEFINE_integer("datasets_parallel_interleave_prefetch", None,
+                     "No-op (tf.data interleave knob, ref :270-272).")
+flags.DEFINE_boolean("use_multi_device_iterator", True,
+                     "No-op: the DeviceFeeder is the MultiDeviceIterator "
+                     "analog (ref :254-258).")
+flags.DEFINE_integer("multi_device_iterator_max_buffer_size", 1,
+                     "No-op (MultiDeviceIterator knob, ref :259-261).")
+flags.DEFINE_boolean("use_resource_vars", False,
+                     "No-op: JAX state is functional (ref :417-421).")
+flags.DEFINE_boolean("use_tf_layers", True,
+                     "No-op: one flax layer path (ref :422-425).")
+flags.DEFINE_boolean("use_python32_barrier", False,
+                     "No-op (CPython barrier workaround, ref :426-428).")
+flags.DEFINE_boolean("compute_lr_on_cpu", False,
+                     "No-op: the LR schedule is fused into the jitted "
+                     "step (ref :429-431).")
+flags.DEFINE_boolean("enable_optimizations", True,
+                     "No-op: XLA optimizations are always on "
+                     "(ref :432-434).")
+flags.DEFINE_string("rewriter_config", None,
+                    "No-op (grappler RewriterConfig, ref :435-438).")
+flags.DEFINE_boolean("allow_growth", None,
+                     "No-op (GPU memory growth, ref :330-332).")
+flags.DEFINE_boolean("force_gpu_compatible", False,
+                     "No-op (GPU pinned-memory knob, ref :333-335).")
+flags.DEFINE_string("gpu_indices", "",
+                    "No-op (GPU ring-order indices, ref :319-320).")
+flags.DEFINE_enum("gpu_thread_mode", "gpu_private",
+                  ("global", "gpu_private", "gpu_shared"),
+                  "No-op (GPU thread pools, ref :321-324).")
+flags.DEFINE_integer("per_gpu_thread_count", 0,
+                     "No-op (GPU thread pools, ref :325-329).")
+flags.DEFINE_boolean("use_unified_memory", False,
+                     "No-op (CUDA unified memory, ref :336-338).")
+flags.DEFINE_boolean("batchnorm_persistent", True,
+                     "No-op (cuDNN CUDNN_BATCHNORM_SPATIAL_PERSISTENT, "
+                     "ref :407-409).")
+flags.DEFINE_integer("autotune_threshold", None,
+                     "No-op (cuDNN autotune, ref :316-318).")
+flags.DEFINE_string("horovod_device", "",
+                    "No-op (Horovod device pinning; the SPMD data plane "
+                    "covers it, ref :568-569).")
+flags.DEFINE_boolean("mkl", False, "No-op (MKL build knob, ref :451).")
+flags.DEFINE_integer("kmp_blocktime", 0,
+                     "No-op (MKL env var, ref :452-455).")
+flags.DEFINE_string("kmp_affinity", "granularity=fine,verbose,compact,1,0",
+                    "No-op (MKL env var, ref :456-458).")
+flags.DEFINE_integer("kmp_settings", 1,
+                     "No-op (MKL env var, ref :459-460).")
+
 # Accepted in both paths: make_params(**kw) translates them, and
 # define_flags(aliases=ALIASES) materializes them as absl alias flags so
 # reference command lines (--num_gpus=8) keep working.

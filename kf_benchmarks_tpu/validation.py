@@ -148,6 +148,14 @@ def validate_cross_flags(params) -> None:
     raise ParamError("--forward_only is incompatible with controller jobs")
   if p.device == "cpu" and p.data_format == "NCHW":
     raise ParamError("NCHW is not supported on cpu device (ref :1323-1326)")
+  if getattr(p, "debugger", None):
+    raise ParamError("--debugger: tfdbg has no TPU analog "
+                     "(ref :370-377); use --trace_file / --tfprof_file "
+                     "for profiling and --graph_file for program dumps")
+  if getattr(p, "trt_mode", ""):
+    raise ParamError("--trt_mode: TensorRT conversion has no TPU analog "
+                     "(ref :615-620); --aot_save_path exports the frozen "
+                     "XLA serving program instead")
   if p.aot_load_path and not p.forward_only:
     raise ParamError("--aot_load_path requires --forward_only (the "
                      "frozen artifact has no training program; ref: "

@@ -253,6 +253,60 @@ class TestAggSmallOnSpecPath:
       validation.validate_cross_flags(p)
 
 
+class TestParityCorpus:
+  """Round-2 flag-corpus parity: every reference CLI flag parses here
+  (VERDICT follow-through on 'every flag consumed or raises')."""
+
+  def test_reference_flag_corpus_is_covered(self):
+    import re
+    ref_path = ("/root/reference/scripts/tf_cnn_benchmarks/"
+                "benchmark_cnn.py")
+    try:
+      with open(ref_path) as f:
+        ref_src = f.read()
+    except FileNotFoundError:
+      pytest.skip("reference checkout unavailable")
+    ref_flags = set(re.findall(r"flags\.DEFINE_\w+\(\s*'([a-z0-9_]+)'",
+                               ref_src))
+    from kf_benchmarks_tpu import flags as flags_lib
+    from kf_benchmarks_tpu.params import ALIASES
+    ours = set(flags_lib.param_specs) | set(ALIASES)
+    missing = ref_flags - ours
+    assert not missing, f"reference flags not accepted: {sorted(missing)}"
+
+  def test_noop_flags_report_a_note(self, capsys):
+    from kf_benchmarks_tpu.benchmark import report_noop_parity_flags
+    p = params_lib.make_params(mkl=True, use_unified_memory=True)
+    report_noop_parity_flags(p)
+    out = capsys.readouterr().out
+    assert "--mkl" in out and "--use_unified_memory" in out
+    assert "no effect on TPU" in out
+
+  def test_debugger_rejected(self):
+    p = params_lib.make_params(debugger="cli")
+    with pytest.raises(validation.ParamError, match="tfdbg"):
+      validation.validate_cross_flags(p)
+
+  def test_trt_mode_rejected_with_aot_pointer(self):
+    p = params_lib.make_params(trt_mode="FP16")
+    with pytest.raises(validation.ParamError, match="aot_save_path"):
+      validation.validate_cross_flags(p)
+
+  def test_repeat_cached_sample_serves_one_record(self, tmp_path):
+    import os
+    from kf_benchmarks_tpu.data import tfrecord, datasets, preprocessing
+    d = str(tmp_path)
+    with tfrecord.TFRecordWriter(
+        os.path.join(d, "train-00000-of-00001")) as w:
+      for payload in (b"first", b"second", b"third"):
+        w.write(payload)
+    pre = preprocessing.InputPreprocessor(
+        batch_size=1, output_shape=(2, 2, 3), repeat_cached_sample=True)
+    ds = datasets.ImagenetDataset(data_dir=d)
+    stream = pre._record_stream(ds, "train")
+    assert [next(stream) for _ in range(5)] == [b"first"] * 5
+
+
 class TestBroadcastDtypes:
   def test_broadcast_preserves_int32_above_2_24(self):
     mesh = _mesh()
