@@ -143,6 +143,11 @@ _NOOP_PARITY_FLAGS = {
     "kmp_blocktime": ("MKL env var"),
     "kmp_affinity": ("MKL env var"),
     "kmp_settings": ("MKL env var"),
+    "local_parameter_device": (
+        "PS-style variable placement maps to sharded state on TPU "
+        "(SURVEY 5.8); the mesh determines placement"),
+    "num_inter_threads": (
+        "host inter-op scheduling belongs to XLA (ref :209-214)"),
 }
 
 
@@ -179,6 +184,16 @@ def setup(params):
   # Platform pre-run hook (ref: platforms_util.initialize, called from
   # setup at benchmark_cnn.py:3356-3395). The cluster manager also goes
   # through the platform dispatch so vendor overrides take effect.
+  # --coordinator_address/--num_processes/--process_index map onto the
+  # KFCOORD_* env the coordination-service clients read (kfrun sets the
+  # env directly; these flags cover hand-launched processes,
+  # ref: kungfu-run env propagation, SURVEY 2.9).
+  if params.coordinator_address and "KFCOORD_HOST" not in os.environ:
+    host, _, port = params.coordinator_address.partition(":")
+    os.environ["KFCOORD_HOST"] = host
+    os.environ["KFCOORD_PORT"] = port or "0"
+    os.environ["KFCOORD_WORLD"] = str(params.num_processes)
+    os.environ.setdefault("KFCOORD_RANK_HINT", str(params.process_index))
   from kf_benchmarks_tpu.platforms import util as platforms_util
   platforms_util.initialize(params)
   platforms_util.get_cluster_manager(params)
@@ -206,8 +221,11 @@ class BenchmarkCNN:
     # Optional resize driver (tests inject a ScheduledController; the
     # elastic flag wires the coordination service via KFCOORD_* env).
     self.elastic_controller = None
+    # --use_synthetic_gpu_images forces synthetic inputs even when a
+    # data_dir is set (ref: the flag gates use_synthetic_gpu_inputs).
+    data_dir = None if params.use_synthetic_gpu_images else params.data_dir
     self.dataset = dataset or datasets.create_dataset(
-        params.data_dir, params.data_name)
+        data_dir, params.data_name)
     self.model = model or model_config.get_model_config(
         params.model, self.dataset.name, params)
     if params.batch_size:
@@ -244,6 +262,17 @@ class BenchmarkCNN:
       global_batch = self.batch_size * max(self.num_workers, 1)
       return int(np.ceil(p.num_epochs * per_epoch / global_batch))
     return 100  # reference default (ref: benchmark_cnn.py:137-139)
+
+  def _num_eval_batches_from_epochs(self):
+    """--num_eval_epochs -> batches over the validation set (ref:
+    get_num_batches_and_epochs applied to eval params,
+    benchmark_cnn.py:1429-1446)."""
+    p = self.params
+    if p.num_eval_epochs is None:
+      return None
+    per_epoch = self.dataset.num_examples_per_epoch("validation")
+    global_batch = self.batch_size * max(self.num_workers, 1)
+    return int(np.ceil(p.num_eval_epochs * per_epoch / global_batch))
 
   # -- info ----------------------------------------------------------------
 
@@ -346,8 +375,14 @@ class BenchmarkCNN:
                 7919 * getattr(self, "_input_incarnation", 0)),
           shift_ratio=(kungfu.current_rank() /
                        max(kungfu.current_cluster_size(), 1)),
-          num_threads=p.datasets_num_private_threads or 8,
-          repeat_cached_sample=bool(p.datasets_repeat_cached_sample))
+          # Thread-count precedence: the dataset-private pool flag, then
+          # the host intra-op pool size, then the parse-parallelism
+          # default (ref :203-208, :248-253, map parallelism).
+          num_threads=(p.datasets_num_private_threads or
+                       p.num_intra_threads or
+                       p.input_preprocessing_parallelism or 8),
+          repeat_cached_sample=bool(p.datasets_repeat_cached_sample),
+          use_caching=bool(p.datasets_use_caching))
       if hasattr(pre, "max_label_length"):
         # Speech: label padding must match the model's static label slot.
         pre.max_label_length = getattr(self.model, "max_label_length",
@@ -840,7 +875,8 @@ class BenchmarkCNN:
                  next_batch=None) -> Dict[str, Any]:
     """One pass over the eval batches (ref: benchmark_cnn.py:1864-1923)."""
     p = self.params
-    num_eval = p.num_eval_batches or self.num_batches
+    num_eval = p.num_eval_batches or self._num_eval_batches_from_epochs() \
+        or self.num_batches
     top1_sum = top5_sum = 0.0
     start = time.time()
     # Same lag-2 fetch pipeline as the train loop (utils/pipeline.py).

@@ -205,7 +205,8 @@ class InputPreprocessor:
                train: bool = True, distortions: bool = False,
                resize_method: str = "bilinear", seed: int = 301,
                shift_ratio: float = 0.0, num_threads: int = 8,
-               repeat_cached_sample: bool = False):
+               repeat_cached_sample: bool = False,
+               use_caching: bool = False):
     self.batch_size = batch_size
     self.height, self.width, self.depth = output_shape
     self.train = train
@@ -218,6 +219,9 @@ class InputPreprocessor:
     # emulate memory-speed IO (ref: preprocessing create_dataset
     # take(1).cache().repeat(), :879-882).
     self.repeat_cached_sample = repeat_cached_sample
+    # --datasets_use_caching: hold the raw records in memory after the
+    # first pass (ref: ds.cache(), :254-258).
+    self.use_caching = use_caching
 
   def minibatches(self, dataset, subset: str) -> Iterator[
       Tuple[np.ndarray, np.ndarray]]:
@@ -241,12 +245,24 @@ class InputPreprocessor:
       while True:
         yield first
     rng = random.Random(self.seed)
+    cache = [] if self.use_caching else None
+    first_pass = True
     while True:
+      if cache is not None and not first_pass:
+        order2 = list(cache)
+        if self.train:
+          rng.shuffle(order2)
+        yield from order2
+        continue
       order = list(shards)
       if self.train:
         rng.shuffle(order)
       for path in order:
-        yield from tfrecord.read_records(path)
+        for record in tfrecord.read_records(path):
+          if cache is not None:
+            cache.append(record)
+          yield record
+      first_pass = False
       if not self.train:
         break
 
@@ -290,6 +306,43 @@ class RecordInputImagePreprocessor(InputPreprocessor):
         yield images, labels
     finally:
       pool.shutdown(wait=False)
+
+
+class OfficialImagenetPreprocessor(RecordInputImagePreprocessor):
+  """The official-models ImageNet preprocessing variant
+  (ref: preprocessing.py:635-652 ImagenetPreprocessor, which delegates to
+  official.vision...imagenet_preprocessing.preprocess_image).
+
+  Differences from the default pipeline: eval resizes preserving aspect
+  ratio so the short side is 256 then takes a central HxW crop (instead
+  of the 87.5% crop), train never color-distorts, and normalization
+  subtracts the ImageNet channel means in [0,255] space with no std
+  scaling (the official CHANNEL_MEANS convention)."""
+
+  CHANNEL_MEANS = np.asarray([123.68, 116.779, 103.939], np.float32)
+  RESIZE_MIN = 256
+
+  def _preprocess_one(self, record: bytes, batch_position: int,
+                      rng: random.Random):
+    image_buffer, label, bbox = parse_example_proto(record)
+    if self.train:
+      # Same crop/flip pipeline as the default path, bilinear, no color
+      # distortion (the official preprocess_image train path).
+      arr = train_image(image_buffer, self.height, self.width, bbox,
+                        batch_position, "bilinear", distortions=False,
+                        rng=rng)
+    else:
+      img = Image.open(io.BytesIO(image_buffer)).convert("RGB")
+      iw, ih = img.size
+      scale = self.RESIZE_MIN / min(iw, ih)
+      img = img.resize((max(int(iw * scale), self.width),
+                        max(int(ih * scale), self.height)),
+                       Image.BILINEAR)
+      iw, ih = img.size
+      x, y = (iw - self.width) // 2, (ih - self.height) // 2
+      img = img.crop((x, y, x + self.width, y + self.height))
+      arr = np.asarray(img, np.float32)
+    return arr - self.CHANNEL_MEANS, label
 
 
 class Cifar10ImagePreprocessor(InputPreprocessor):
@@ -667,9 +720,16 @@ def get_preprocessor(dataset_name: str, kind: str = "default"):
   """Name -> preprocessor class (ref: datasets.py:208-229 maps)."""
   if kind == "test":
     return TestImagePreprocessor
+  if kind == "official_models_imagenet":
+    # (ref: the imagenet map's second entry, datasets.py:208-229 +
+    # preprocessing.py:635-652)
+    if dataset_name != "imagenet":
+      raise ValueError("official_models_imagenet preprocessing applies "
+                       f"to the imagenet dataset, not {dataset_name!r}")
+    return OfficialImagenetPreprocessor
   if kind != "default":
-    raise ValueError(f"Unknown input preprocessor {kind!r}; "
-                     f"expected 'default' or 'test'")
+    raise ValueError(f"Unknown input preprocessor {kind!r}; expected "
+                     f"'default', 'official_models_imagenet', or 'test'")
   if dataset_name not in _PREPROCESSORS:
     raise NotImplementedError(
         f"No input preprocessor for dataset {dataset_name!r}")

@@ -293,7 +293,6 @@ flags.DEFINE_boolean("winograd_nonfused", True,
 flags.DEFINE_boolean("sparse_to_dense_grads", False,
                      "Densify sparse gradients (ref :518-519; JAX grads are "
                      "dense, kept for parity).")
-flags.DEFINE_float("loss_scale_normal_steps_reset", 0.0, "(internal)")
 flags.DEFINE_enum("loss_type_to_report", "total_loss",
                   ("base_loss", "total_loss"),
                   "Which loss the step line prints (ref :346-353).")

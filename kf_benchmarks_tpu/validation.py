@@ -39,6 +39,17 @@ def validate_cross_flags(params) -> None:
     raise ParamError("--num_batches must be positive")
   if p.num_epochs is not None and p.num_epochs <= 0:
     raise ParamError("--num_epochs must be positive")
+  if p.num_eval_batches is not None and p.num_eval_epochs is not None:
+    raise ParamError("At most one of --num_eval_batches and "
+                     "--num_eval_epochs may be set (ref "
+                     "get_num_batches_and_epochs, :782-800)")
+  if p.num_eval_batches is not None and p.num_eval_batches <= 0:
+    raise ParamError("--num_eval_batches must be positive")
+  if p.num_eval_epochs is not None and p.num_eval_epochs <= 0:
+    raise ParamError("--num_eval_epochs must be positive")
+  if p.coordinator_address and ":" not in p.coordinator_address:
+    raise ParamError("--coordinator_address must be host:port "
+                     f"(got {p.coordinator_address!r})")
   if p.forward_only and p.variable_update in ("distributed_replicated",
                                               "distributed_all_reduce",
                                               "collective_all_reduce"):
@@ -148,6 +159,11 @@ def validate_cross_flags(params) -> None:
     raise ParamError("--forward_only is incompatible with controller jobs")
   if p.device == "cpu" and p.data_format == "NCHW":
     raise ParamError("NCHW is not supported on cpu device (ref :1323-1326)")
+  if p.controller_host:
+    raise ParamError(
+        "--controller_host: the controller role has no TPU analog -- "
+        "distributed_all_reduce's single-session graph maps to the flat "
+        "SPMD program every worker runs (SURVEY 5.8; ref :576)")
   if getattr(p, "debugger", None):
     raise ParamError("--debugger: tfdbg has no TPU analog "
                      "(ref :370-377); use --trace_file / --tfprof_file "

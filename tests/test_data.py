@@ -172,3 +172,31 @@ def test_train_on_real_tfrecords(tmp_path):
   stats = bench.run()
   assert stats["num_steps"] == 2
   assert np.isfinite(stats["last_average_loss"])
+
+
+def test_official_models_imagenet_preprocessor(tmp_path):
+  """The official-models ImageNet variant: short-side-256 central crop at
+  eval, channel-mean normalization in [0,255] space (ref:
+  preprocessing.py:635-652 ImagenetPreprocessor)."""
+  from kf_benchmarks_tpu.data import tfrecord_image_generator
+  d = str(tmp_path)
+  tfrecord_image_generator.write_color_square_records(
+      d, num_train_shards=1, num_validation_shards=1,
+      examples_per_shard=4, image_size=64)
+  ds = datasets.ImagenetDataset(data_dir=d)
+  cls = preprocessing.get_preprocessor("imagenet",
+                                       "official_models_imagenet")
+  assert cls is preprocessing.OfficialImagenetPreprocessor
+  pre = cls(batch_size=2, output_shape=(32, 32, 3), train=False,
+            distortions=False, resize_method="bilinear", seed=1,
+            shift_ratio=0.0, num_threads=1)
+  images, labels = next(iter(pre.minibatches(ds, "validation")))
+  assert images.shape == (2, 32, 32, 3)
+  # Channel-mean normalization keeps values in roughly [-124, 152].
+  assert images.min() >= -130 and images.max() <= 160
+  # Unknown kinds and wrong datasets reject loudly.
+  import pytest
+  with pytest.raises(ValueError, match="imagenet dataset"):
+    preprocessing.get_preprocessor("cifar10", "official_models_imagenet")
+  with pytest.raises(ValueError, match="Unknown input preprocessor"):
+    preprocessing.get_preprocessor("imagenet", "bogus")
