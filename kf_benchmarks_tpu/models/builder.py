@@ -51,10 +51,12 @@ class CompactBatchNorm(nn.Module):
   multiply-add in the compute dtype, which XLA fuses with the neighboring
   ReLU/residual ops.
 
-  Variable layout matches nn.BatchNorm (params: scale/bias, batch_stats:
-  mean/var, float32) so checkpoints are interchangeable. Semantics match
-  the reference's batch norm (ref: convnet_builder.py:408-462) with
-  use_fast_variance statistics.
+  Leaf layout matches nn.BatchNorm (params: scale/bias, batch_stats:
+  mean/var, float32), so a checkpoint is interchangeable wherever the
+  module is given an explicit name (the builder passes name=); under
+  flax auto-naming the module-class prefix differs (CompactBatchNorm_N
+  vs BatchNorm_N). Semantics match the reference's batch norm
+  (ref: convnet_builder.py:408-462) with use_fast_variance statistics.
   """
   use_running_average: bool
   momentum: float = 0.999

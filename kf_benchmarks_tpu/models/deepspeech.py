@@ -27,6 +27,7 @@ import flax.linen as nn
 import optax
 
 from kf_benchmarks_tpu.models import model as model_lib
+from kf_benchmarks_tpu.models.builder import CompactBatchNorm
 
 SPEECH_LABELS = " abcdefghijklmnopqrstuvwxyz'-"
 BLANK_INDEX = 28  # ref: DeepSpeechDecoder(labels, blank_index=28)
@@ -91,9 +92,10 @@ class _DS2Module(nn.Module):
   param_dtype: Any = jnp.float32
 
   def _bn(self, x):
-    return nn.BatchNorm(use_running_average=not self.phase_train,
-                        momentum=0.997, epsilon=1e-5, dtype=self.dtype,
-                        param_dtype=self.param_dtype)(x)
+    return CompactBatchNorm(use_running_average=not self.phase_train,
+                            momentum=0.997, epsilon=1e-5, use_scale=True,
+                            use_bias=True, dtype=self.dtype,
+                            param_dtype=self.param_dtype)(x)
 
   def _conv_bn(self, x, kernel, strides, padding):
     x = jnp.pad(x, ((0, 0), (padding[0], padding[0]),
