@@ -47,16 +47,19 @@ class CompactBatchNorm(nn.Module):
   f32 activation traffic is pure HBM cost on a benchmark that is
   bandwidth-bound (see PERF.md). Here the statistics are still accumulated
   in float32 -- the upcast fuses into the reduction so the tensor is read
-  once at compute precision -- but the normalize is a single per-channel
-  multiply-add in the compute dtype, which XLA fuses with the neighboring
-  ReLU/residual ops.
+  once at compute precision -- and the normalize runs subtract-first in
+  the compute dtype ((x - mean) * inv*scale + bias: the subtraction of
+  nearby values is exact, preserving full relative precision on the
+  normalized output), which XLA fuses with the neighboring ReLU/residual
+  ops.
 
   Leaf layout matches nn.BatchNorm (params: scale/bias, batch_stats:
   mean/var, float32), so a checkpoint is interchangeable wherever the
-  module is given an explicit name (the builder passes name=); under
-  flax auto-naming the module-class prefix differs (CompactBatchNorm_N
-  vs BatchNorm_N). Semantics match the reference's batch norm
-  (ref: convnet_builder.py:408-462) with use_fast_variance statistics.
+  module is given an explicit name (the builder passes name=). Call
+  sites that relied on nn.BatchNorm's auto-generated ``BatchNorm_N``
+  scope names use the ``BatchNorm`` subclass below instead. Semantics
+  match the reference's batch norm (ref: convnet_builder.py:408-462)
+  with use_fast_variance statistics.
   """
   use_running_average: bool
   momentum: float = 0.999
@@ -94,11 +97,22 @@ class CompactBatchNorm(nn.Module):
     if self.use_bias:
       bias = self.param("bias", nn.initializers.zeros, (feat,),
                         self.param_dtype).astype(jnp.float32)
-    # Fold (mean, inv, scale, bias) into one per-channel (a, b) pair cast
-    # once to the compute dtype: y = x * a + b.
+    # Subtract-first normalize in the compute dtype:
+    # y = (x - mean) * (inv*scale) + bias. Subtraction of nearby values
+    # is exact in floating point, so this keeps full relative precision
+    # on the O(1) normalized output; the folded y = x*a + b form loses
+    # ~mean/std relative bits to cancellation of two rounded bf16
+    # products when channel means are large.
     a = (inv * scale).astype(self.dtype)
-    b = (bias - mean * inv * scale).astype(self.dtype)
-    return x.astype(self.dtype) * a + b
+    return ((x.astype(self.dtype) - mean.astype(self.dtype)) * a +
+            bias.astype(self.dtype))
+
+
+class BatchNorm(CompactBatchNorm):
+  """Checkpoint-name-compatible alias: flax auto-names modules by class,
+  so call sites that relied on nn.BatchNorm's auto-generated
+  ``BatchNorm_N`` scope names (mobilenet/nasnet/deepspeech) use this
+  subclass and keep their parameter tree layout."""
 
 
 class ConvNetBuilder:

@@ -27,7 +27,7 @@ import flax.linen as nn
 import optax
 
 from kf_benchmarks_tpu.models import model as model_lib
-from kf_benchmarks_tpu.models.builder import CompactBatchNorm
+from kf_benchmarks_tpu.models.builder import BatchNorm
 
 SPEECH_LABELS = " abcdefghijklmnopqrstuvwxyz'-"
 BLANK_INDEX = 28  # ref: DeepSpeechDecoder(labels, blank_index=28)
@@ -92,7 +92,7 @@ class _DS2Module(nn.Module):
   param_dtype: Any = jnp.float32
 
   def _bn(self, x):
-    return CompactBatchNorm(use_running_average=not self.phase_train,
+    return BatchNorm(use_running_average=not self.phase_train,
                             momentum=0.997, epsilon=1e-5, use_scale=True,
                             use_bias=True, dtype=self.dtype,
                             param_dtype=self.param_dtype)(x)

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import flax.linen as nn
 
 from kf_benchmarks_tpu.models import model as model_lib
-from kf_benchmarks_tpu.models.builder import CompactBatchNorm
+from kf_benchmarks_tpu.models.builder import BatchNorm
 
 
 def make_divisible(v: float, divisor: int = 8,
@@ -88,7 +88,7 @@ class MobilenetV2Module(nn.Module):
   def _bn(self, x):
     # slim defaults the reference trains with: decay 0.997, eps 0.001
     # (ref: mobilenet.py training_scope).
-    return CompactBatchNorm(
+    return BatchNorm(
         use_running_average=not self.phase_train, momentum=0.997,
         epsilon=1e-3, use_scale=True, use_bias=True,
         dtype=self.dtype, param_dtype=self.param_dtype)(x)

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import flax.linen as nn
 
 from kf_benchmarks_tpu.models import model as model_lib
-from kf_benchmarks_tpu.models.builder import CompactBatchNorm
+from kf_benchmarks_tpu.models.builder import BatchNorm
 
 # NASNet-A cell op tables (ref: nasnet_utils.py:465-491).
 NORMAL_OPERATIONS = (
@@ -81,7 +81,7 @@ class NasnetModule(nn.Module):
 
   def _bn(self, x):
     # slim nasnet arg_scope: decay 0.9997, eps 0.001.
-    return CompactBatchNorm(use_running_average=not self.phase_train,
+    return BatchNorm(use_running_average=not self.phase_train,
                             momentum=0.9997, epsilon=1e-3, use_scale=True,
                             use_bias=True, dtype=self.dtype,
                             param_dtype=self.param_dtype)(x)

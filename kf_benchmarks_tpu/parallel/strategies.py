@@ -106,6 +106,34 @@ class ParameterServerStrategy(ReplicatedStrategy):
   name = "parameter_server"
 
 
+class AsyncParameterServerStrategy(ReplicatedStrategy):
+  """Async PS (--cross_replica_sync=false, ref: benchmark_cnn.py:520-522).
+
+  In the reference every worker applies its own UNAGGREGATED gradient to
+  the one PS-hosted weight copy; the weights stay shared, only the
+  averaging disappears. The SPMD reformulation keeps exactly those two
+  properties: gradients are psum-SUMMED (N sequential unaveraged plain-SGD
+  applications to shared weights collapse into one update by the gradient
+  sum -- validation restricts this mode to --optimizer=sgd, where the
+  collapse is exact), weights and BN stats remain replicated. The
+  reference's timing asynchrony itself (workers at different steps,
+  GlobalStepWatcher) has no SPMD analog -- steps run in lockstep; the
+  per-step window math is therefore exact (see KungFuStrategy's
+  throughput note)."""
+
+  name = "parameter_server(async)"
+  # Unaveraged gradients: the effective step scale follows the
+  # per-worker batch, as the reference's async mode behaves.
+  cross_replica = False
+
+  def reduce_gradients(self, grads, axis_name=REPLICA_AXIS):
+    if self.reducer is not None:
+      grads = self.reducer(grads, axis_name)
+      n = lax.axis_size(axis_name)
+      return jax.tree.map(lambda g: g * n, grads)  # undo the mean
+    return jax.tree.map(lambda g: lax.psum(g, axis_name), grads)
+
+
 class CollectiveAllReduceStrategy(ReplicatedStrategy):
   """Spec-driven reduction (ref: variable_mgr.py:486-625). The all-reduce
   spec planner (ops/allreduce.py) may decompose pmean into
@@ -177,11 +205,15 @@ def get_strategy(params) -> Strategy:
   vu = params.variable_update
   if vu == "independent":
     return IndependentStrategy(params)
+  if vu == "kungfu":
+    return KungFuStrategy(params, option=params.kungfu_option)
   from kf_benchmarks_tpu.ops import allreduce
   reducer = allreduce.build_reducer(params)
   if vu in ("replicated", "distributed_replicated"):
     return ReplicatedStrategy(params, reducer=reducer)
   if vu == "parameter_server":
+    if not params.cross_replica_sync:
+      return AsyncParameterServerStrategy(params, reducer=reducer)
     return ParameterServerStrategy(params, reducer=reducer)
   if vu in ("collective_all_reduce", "distributed_all_reduce"):
     return CollectiveAllReduceStrategy(
@@ -192,6 +224,4 @@ def get_strategy(params) -> Strategy:
     s = ReplicatedStrategy(params, reducer=reducer)
     s.name = "horovod"
     return s
-  if vu == "kungfu":
-    return KungFuStrategy(params, option=params.kungfu_option)
   raise ValueError(f"Unknown variable_update {vu!r}")

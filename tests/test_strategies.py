@@ -104,6 +104,10 @@ def _manual(mode, steps=5, w0=0.5):
       # perm convention [(i, (i+shift)%n)]: source i sends TO (i+shift),
       # so receiver j gets from (j - shift) mod n.
       w = 0.5 * (w + np.roll(w, shift))
+    elif mode == "async_ps":
+      # One shared weight copy; every replica's unaveraged gradient
+      # lands on it (ref async PS, benchmark_cnn.py:520-522).
+      w = w - LR * g.sum()
     else:
       raise ValueError(mode)
   return losses, w
@@ -283,3 +287,28 @@ def test_cluster_introspection():
   assert kungfu.current_cluster_size() >= 1
   assert kungfu.current_rank() == 0
   kungfu.run_barrier()  # no-op single process; must not raise
+
+
+def test_async_ps_mode_sums_unaveraged_gradients():
+  """--variable_update=parameter_server --cross_replica_sync=false: the
+  async-PS mode (ref: benchmark_cnn.py:520-522) keeps ONE shared weight
+  copy and applies every replica's unaveraged gradient to it -- the SPMD
+  collapse of N sequential unaveraged SGD applications is one update by
+  the gradient SUM."""
+  p = params_lib.make_params(variable_update="parameter_server",
+                             cross_replica_sync=False,
+                             num_devices=N_REPLICAS, device="cpu")
+  s = strategies.get_strategy(p)
+  assert not s.cross_replica
+  losses, w = _run(s, steps=5)
+  want_losses, want_w = _manual("async_ps", steps=5)
+  np.testing.assert_allclose(losses, want_losses, rtol=1e-5)
+  # Weights stayed identical across replicas (shared model, not N forks).
+  np.testing.assert_allclose(w, want_w, rtol=1e-5)
+  assert np.ptp(w) < 1e-6
+  # Stateful optimizers cannot ride the sum-collapse: rejected loudly.
+  from kf_benchmarks_tpu import validation
+  with pytest.raises(validation.ParamError, match="optimizer=sgd"):
+    validation.validate_cross_flags(params_lib.make_params(
+        variable_update="parameter_server", cross_replica_sync=False,
+        optimizer="momentum"))
