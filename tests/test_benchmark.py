@@ -90,3 +90,44 @@ def test_batch_size_default_from_model():
   p = params_lib.make_params(model="trivial", device="cpu")
   bench = benchmark.BenchmarkCNN(p)
   assert bench.batch_size_per_device == 32  # trivial model default
+
+
+def test_eval_during_training_fires_exactly_on_schedule():
+  """Deterministic eval-during-training cadence e2e: the accuracy lines
+  appear exactly at the scheduled steps, interleaved in order with the
+  step lines (the ref's deterministic eval-count tests,
+  benchmark_cnn_test.py:1005-1080 / SURVEY 4.5)."""
+  logs, stats = _run_and_scrape(
+      num_batches=10, display_every=1,
+      eval_during_training_at_specified_steps=["3", "7", "10"])
+  acc_idx = [i for i, l in enumerate(logs)
+             if l.startswith("Accuracy @ 1")]
+  assert len(acc_idx) == 3, logs
+  # Each accuracy line follows its scheduled step's line.
+  step_of = {}
+  for i, l in enumerate(logs):
+    m = STEP_RE.match(l)
+    if m:
+      step_of[i] = int(m.group(1))
+  for want_step, ai in zip([3, 7, 10], acc_idx):
+    prior_steps = [s for i, s in step_of.items() if i < ai]
+    assert prior_steps and max(prior_steps) == want_step, (want_step, logs)
+  assert stats["num_steps"] == 10
+
+
+def test_eval_during_training_epoch_schedule_fires():
+  """Epoch-based cadence end-to-end (synthetic imagenet: 1.28M examples;
+  shrink via an explicit epoch fraction -> step mapping check)."""
+  logs, stats = _run_and_scrape(
+      num_batches=6, display_every=1, batch_size=4,
+      eval_during_training_at_specified_epochs=[str(8 / 1281167),
+                                                str(20 / 1281167)])
+  acc_idx = [i for i, l in enumerate(logs)
+             if l.startswith("Accuracy @ 1")]
+  # 8 examples / batch 4 -> step 2; 20 examples -> step 5 (ceil-div).
+  assert len(acc_idx) == 2, logs
+  step_of = {i: int(m.group(1)) for i, l in enumerate(logs)
+             if (m := STEP_RE.match(l))}
+  for want_step, ai in zip([2, 5], acc_idx):
+    prior = [s for i, s in step_of.items() if i < ai]
+    assert prior and max(prior) == want_step, (want_step, logs)
