@@ -1,0 +1,84 @@
+"""Gate experiment: Pallas 3x3 SAME conv vs XLA conv on a ResNet shape.
+
+If Pallas is within ~10% of XLA, fusing BN stats/normalize into conv
+kernels (PERF.md's remaining path to 3500+ img/s) is worth building;
+otherwise the bound stands.
+
+Shape: x[256, 28, 28, 128] * W[3, 3, 128, 128] -> y[256, 28, 28, 128]
+(the stage-3 ResNet-50 workhorse). Strategy: 9 shifted matmuls
+accumulated in VMEM, grid over the batch dimension, full H*W*C tile per
+step (28*28*128 bf16 = 200 KiB -- fits VMEM comfortably).
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+B, H, W, C = 256, 28, 28, 128
+CO = 128
+
+
+def conv_kernel(x_ref, w_ref, o_ref):
+  # x_ref: [1, H+2, W+2, C] (padded); w_ref: [3, 3, C, CO]
+  x = x_ref[0]
+  acc = jnp.zeros((H * W, CO), jnp.float32)
+  for dy in range(3):
+    for dx in range(3):
+      patch = x[dy:dy + H, dx:dx + W, :].reshape(H * W, C)
+      acc += jnp.dot(patch, w_ref[dy, dx],
+                     preferred_element_type=jnp.float32)
+  o_ref[0] = acc.reshape(H, W, CO).astype(o_ref.dtype)
+
+
+@jax.jit
+def pallas_conv(xp, w):
+  return pl.pallas_call(
+      conv_kernel,
+      grid=(B,),
+      in_specs=[
+          pl.BlockSpec((1, H + 2, W + 2, C), lambda b: (b, 0, 0, 0)),
+          pl.BlockSpec((3, 3, C, CO), lambda b: (0, 0, 0, 0)),
+      ],
+      out_specs=pl.BlockSpec((1, H, W, CO), lambda b: (b, 0, 0, 0)),
+      out_shape=jax.ShapeDtypeStruct((B, H, W, CO), jnp.bfloat16),
+  )(xp, w)
+
+
+@jax.jit
+def xla_conv(x, w):
+  return jax.lax.conv_general_dilated(
+      x, w, (1, 1), "SAME",
+      dimension_numbers=("NHWC", "HWIO", "NHWC"),
+      preferred_element_type=jnp.bfloat16)
+
+
+def bench(fn, *args, iters=30):
+  out = fn(*args)
+  jax.block_until_ready(out)
+  t0 = time.time()
+  for _ in range(iters):
+    out = fn(*args)
+  jax.block_until_ready(out)
+  return (time.time() - t0) / iters
+
+
+key = jax.random.PRNGKey(0)
+x = jax.random.normal(key, (B, H, W, C), jnp.bfloat16)
+xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+w = jax.random.normal(key, (3, 3, C, CO), jnp.bfloat16) * 0.05
+
+y_xla = xla_conv(x, w)
+y_pal = pallas_conv(xp, w)
+err = float(jnp.max(jnp.abs(y_xla.astype(jnp.float32) -
+                            y_pal.astype(jnp.float32))))
+print("max abs diff:", err)
+
+t_xla = bench(xla_conv, x, w)
+t_pal = bench(pallas_conv, xp, w)
+flops = 2 * B * H * W * C * CO * 9
+print(f"XLA conv:    {t_xla*1e3:.3f} ms  ({flops/t_xla/1e12:.1f} TFLOP/s)")
+print(f"Pallas conv: {t_pal*1e3:.3f} ms  ({flops/t_pal/1e12:.1f} TFLOP/s)")
+print(f"ratio pallas/xla: {t_pal/t_xla:.2f}x")
