@@ -45,7 +45,7 @@ def main():
       model="resnet50",
       batch_size=256 if on_tpu else 8,
       num_batches=50 if on_tpu else 5,
-      num_warmup_batches=5 if on_tpu else 1,
+      num_warmup_batches=None if on_tpu else 1,
       device="tpu" if on_tpu else "cpu",
       num_devices=1,
       variable_update="replicated",

@@ -9,12 +9,10 @@ Shape: x[256, 28, 28, 128] * W[3, 3, 128, 128] -> y[256, 28, 28, 128]
 accumulated in VMEM, grid over the batch dimension, full H*W*C tile per
 step (28*28*128 bf16 = 200 KiB -- fits VMEM comfortably).
 """
-import functools
 import time
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.experimental import pallas as pl
 
 B, H, W, C = 256, 28, 28, 128

@@ -241,9 +241,11 @@ class BenchmarkCNN:
     self.eval_step_set = compute_eval_step_set(
         params, self.batch_size * max(self.num_workers, 1),
         self.dataset.num_examples_per_epoch("train"), self.num_batches)
+    # Default matches the reference: max(10, autotune warmup) with no
+    # autotune phase on TPU (ref: benchmark_cnn.py:1257).
     self.num_warmup_batches = (
         params.num_warmup_batches if params.num_warmup_batches is not None
-        else 5)
+        else 10)
     self.display_every = params.display_every
     dtype = jnp.float32
     if params.use_fp16:
