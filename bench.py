@@ -44,7 +44,8 @@ def main():
   params = params_lib.make_params(
       model="resnet50",
       batch_size=256 if on_tpu else 8,
-      num_batches=50 if on_tpu else 5,
+      num_batches=None if on_tpu else 5,  # None -> the reference default
+                                          # (100, the baseline logs' config)
       num_warmup_batches=None if on_tpu else 1,
       device="tpu" if on_tpu else "cpu",
       num_devices=1,

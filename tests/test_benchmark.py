@@ -92,6 +92,16 @@ def test_batch_size_default_from_model():
   assert bench.batch_size_per_device == 32  # trivial model default
 
 
+def test_warmup_default_matches_reference():
+  """Unset num_warmup_batches resolves to 10, the reference's
+  max(10, autotune-warmup) default (ref: benchmark_cnn.py:1257)."""
+  p = params_lib.make_params(model="trivial", device="cpu")
+  assert benchmark.BenchmarkCNN(p).num_warmup_batches == 10
+  p = params_lib.make_params(model="trivial", device="cpu",
+                             num_warmup_batches=3)
+  assert benchmark.BenchmarkCNN(p).num_warmup_batches == 3
+
+
 def test_eval_during_training_fires_exactly_on_schedule():
   """Deterministic eval-during-training cadence e2e: the accuracy lines
   appear exactly at the scheduled steps, interleaved in order with the
