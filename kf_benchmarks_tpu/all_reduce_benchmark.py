@@ -33,6 +33,7 @@ from kf_benchmarks_tpu.models import model_config
 from kf_benchmarks_tpu.ops import allreduce
 from kf_benchmarks_tpu.parallel import mesh as mesh_lib
 from kf_benchmarks_tpu.parallel.mesh import REPLICA_AXIS
+from kf_benchmarks_tpu.utils import sync
 from kf_benchmarks_tpu.utils import log as log_util
 
 if "iters_per_step" not in flags.param_specs:
@@ -118,14 +119,19 @@ def run_benchmark(params) -> Dict[str, float]:
   warmup = params.num_warmup_batches
   if warmup is None:
     warmup = 2
+
+  # Both regions end with a real value fetch of the smallest output
+  # tensor: fetching the model-sized tensors themselves would time the
+  # host transfer instead of the all-reduce, and block_until_ready does
+  # not synchronize on the tunneled TPU backend (utils/sync.py).
   for _ in range(max(warmup, 1)):  # includes compile
     out = step(tensors)
-  jax.block_until_ready(out)
+  sync.drain(out)
 
   start = time.monotonic()
   for _ in range(num_steps):
     out = step(tensors)
-  jax.block_until_ready(out)
+  sync.drain(out)
   elapsed = time.monotonic() - start
 
   avg_step = elapsed / num_steps

@@ -32,6 +32,7 @@ from kf_benchmarks_tpu.parallel import strategies
 from kf_benchmarks_tpu.parallel import kungfu
 from kf_benchmarks_tpu.utils import log as log_util
 from kf_benchmarks_tpu.utils import pipeline as pipeline_lib
+from kf_benchmarks_tpu.utils import sync
 
 def log_fn(msg):
   """Late-bound so tests/bench can monkey-patch log_util.log_fn."""
@@ -448,7 +449,9 @@ class BenchmarkCNN:
     t0 = time.time()
     for _ in range(max(self.num_warmup_batches, 1)):
       out = serving_fn(images)
-    jax.block_until_ready(out)
+    # The timed loop must start with an empty device queue
+    # (utils/sync.py on why block_until_ready is not enough).
+    sync.drain(out)
     log_fn("Warmup (load + %d steps): %.1f s" %
            (max(self.num_warmup_batches, 1), time.time() - t0))
     log_fn("Step\tImg/sec\t" + p.loss_type_to_report)
@@ -590,7 +593,10 @@ class BenchmarkCNN:
              f"{p.backbone_model_path}")
     # Replica-0 broadcast at start (ref: benchmark_cnn.py:2094-2100).
     state = state.replace(params=broadcast_init(state.params))
-    jax.block_until_ready(state.params)
+    # Resolve the broadcast so the reported initialization time covers
+    # the real device work (utils/sync.py on why block_until_ready is
+    # not enough).
+    sync.drain(state.params)
     log_fn("Initialization: %.1f s" % (time.time() - t0))
 
     def make_run_step(train_step, eval_step):
@@ -684,8 +690,17 @@ class BenchmarkCNN:
       with observability.maybe_trace_step(
           p.trace_file, w, self.num_warmup_batches - 1):
         state, metrics = run_step(state, images, labels)
-        jax.block_until_ready(metrics["total_loss"])
+        if p.trace_file and w == self.num_warmup_batches - 1:
+          # The trace must span the device execution, so the traced
+          # step resolves inside the profiler context (utils/sync.py on
+          # why block_until_ready is not enough).
+          sync.drain(metrics)
       images, labels = next_batch()
+    if self.num_warmup_batches and not p.trace_file:
+      # Empty the device queue before the clock starts: timing must not
+      # begin with warmup steps still executing (utils/sync.py). With
+      # --trace_file the traced last step already drained in-context.
+      sync.drain(metrics)
     log_fn("Warmup (compile + %d steps): %.1f s" %
            (self.num_warmup_batches, time.time() - t0))
     # Base for globally-meaningful step numbers in metric/summary streams
@@ -771,7 +786,7 @@ class BenchmarkCNN:
         state, metrics = run_step(state, images, labels)
         if trace_this_step:
           # Dispatch is async; the trace must span the device execution.
-          jax.block_until_ready(metrics)
+          sync.drain(metrics)
       images, labels = next_batch()
       images_processed += self.batch_size * max(self.num_workers, 1)
       for done in pipe.push(i + 1, metrics):

@@ -18,6 +18,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kf_benchmarks_tpu.keras_benchmarks.models.timehistory import TimeHistory
+from kf_benchmarks_tpu.utils import sync
 
 
 def fit(module, x_train, y_train, *, batch_size: int, epochs: int,
@@ -74,7 +75,10 @@ def fit(module, x_train, y_train, *, batch_size: int, epochs: int,
       params, opt_state, value = train_step(params, opt_state, x, y,
                                             step_rng)
       epoch_losses.append(value)
-    jax.block_until_ready(params)
+    # Real per-device fetch: block_until_ready does not synchronize on
+    # the tunneled TPU backend (utils/sync.py), and the epoch timing
+    # callback fires right after this.
+    sync.drain(params)
     history["loss"].append(float(jnp.mean(jnp.stack(epoch_losses))))
     if time_callback is not None:
       time_callback.on_epoch_end()

@@ -1,0 +1,32 @@
+"""Device-queue synchronization that works on the tunneled TPU backend.
+
+``jax.block_until_ready`` returns before device execution completes on
+the tunneled (axon) TPU backend (measured in PERF.md's round-2
+follow-up: a "blocked" timing loop reported physically impossible
+throughput), so every wall-clock boundary -- warmup end, init end,
+trace spans, microbenchmark regions -- must synchronize through a real
+value fetch instead.
+"""
+
+import jax
+
+
+def drain(tree) -> None:
+  """Block until all device work feeding ``tree`` has completed.
+
+  Fetches every addressable shard of the smallest array leaf, keeping
+  the host transfer negligible. Per-device execution is in-order, so
+  once each device's shard of the leaf is fetched, everything enqueued
+  on that device before the leaf's producer has completed. Fetching all
+  shards (not the assembled array) matters for replicated leaves, where
+  assembling would read one device and leave the others' queues live.
+  """
+  leaves = [l for l in jax.tree.leaves(tree) if hasattr(l, "dtype")]
+  if not leaves:
+    return
+  leaf = min(leaves, key=lambda x: x.size)
+  shards = getattr(leaf, "addressable_shards", None)
+  if shards:
+    jax.device_get([s.data for s in shards])
+  else:
+    jax.device_get(leaf)

@@ -183,6 +183,11 @@ def validate_cross_flags(params) -> None:
     raise ParamError("--aot_load_path requires --forward_only (the "
                      "frozen artifact has no training program; ref: "
                      "TRT serving path, benchmark_cnn.py:2405-2525)")
+  if p.aot_save_path and not p.forward_only:
+    raise ParamError("--aot_save_path requires --forward_only (the "
+                     "export freezes the inference program, the analog "
+                     "of the reference's forward-only graph freeze; ref: "
+                     "benchmark_cnn.py:2405-2525)")
   if p.aot_load_path and p.aot_save_path:
     raise ParamError("At most one of --aot_load_path and --aot_save_path "
                      "may be set")
