@@ -89,3 +89,27 @@ def test_step_line_jitter_renders_nonzero():
   m = re.search(r"jitter = ([\d.]+)", line)
   assert m, line
   assert float(m.group(1)) > 0.0
+
+
+def test_drain_resolves_sharded_and_replicated_leaves():
+  """sync.drain fetches a shard from every device for both sharded and
+  replicated leaves, returns on empty/non-array trees, and leaves
+  values intact (the timing-boundary sync primitive, utils/sync.py)."""
+  import jax
+  import jax.numpy as jnp
+  from jax.sharding import NamedSharding, PartitionSpec as P
+  from kf_benchmarks_tpu.parallel import mesh as mesh_lib
+  from kf_benchmarks_tpu.utils import sync
+
+  mesh = mesh_lib.build_mesh(4, "cpu")
+  sharded = jax.device_put(
+      jnp.arange(8.0).reshape(4, 2),
+      NamedSharding(mesh, P(mesh_lib.REPLICA_AXIS)))
+  replicated = jax.device_put(jnp.float32(3.5), NamedSharding(mesh, P()))
+  sync.drain({"a": sharded})            # sharded leaf path
+  sync.drain({"b": replicated})         # replicated leaf path
+  sync.drain({"a": sharded, "b": replicated, "c": None})  # picks smallest
+  sync.drain({})                        # empty tree is a no-op
+  sync.drain({"x": 1.0})                # non-array leaves are skipped
+  assert float(replicated) == 3.5
+  assert float(jnp.sum(sharded)) == 28.0
