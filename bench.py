@@ -26,20 +26,15 @@ def main():
   # only the JSON line (benchmark.log_fn late-binds to log_util.log_fn).
   log_util.log_fn = lambda s: print(s, file=sys.stderr, flush=True)
 
-  # Probe TPU availability in a subprocess with a timeout: a wedged TPU
-  # tunnel makes jax.devices() block forever in-process, which must not
-  # hang the bench (it falls back to CPU instead).
-  import subprocess
-  try:
-    probe = subprocess.run(
-        [sys.executable, "-c",
-         "import jax; print(jax.devices()[0].platform)"],
-        capture_output=True, text=True, timeout=120)
-    on_tpu = probe.returncode == 0 and "cpu" not in probe.stdout
-  except subprocess.TimeoutExpired:
-    on_tpu = False
+  # Probe TPU availability out-of-process (a wedged TPU tunnel makes
+  # jax.devices() block forever in-process, which must not hang the
+  # bench); fall back to CPU on failure. The successful probe is cached
+  # in the env, so benchmark.setup() will not re-probe.
+  on_tpu, detail = benchmark.tpu_reachable()
   import jax
   if not on_tpu:
+    print(f"TPU unreachable ({detail}); falling back to CPU",
+          file=sys.stderr, flush=True)
     jax.config.update("jax_platforms", "cpu")
   params = params_lib.make_params(
       model="resnet50",

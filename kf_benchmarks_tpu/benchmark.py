@@ -162,6 +162,35 @@ def report_noop_parity_flags(params) -> None:
              f"has no effect on TPU: {why}")
 
 
+def tpu_reachable(timeout: int = 120):
+  """Probe TPU backend liveness in a subprocess -> (ok, detail).
+
+  A wedged device tunnel makes jax.devices() block forever in-process,
+  so the probe runs out-of-process with a timeout. A successful probe is
+  cached in the environment (inherited by children), so bench.py's
+  fallback check and setup()'s guard share one real probe per run.
+  """
+  if os.environ.get("KF_TPU_PROBE_RESULT") == "ok":
+    return True, ""
+  import subprocess
+  import sys
+  try:
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; print(jax.devices()[0].platform)"],
+        capture_output=True, text=True, timeout=timeout)
+  except subprocess.TimeoutExpired:
+    return False, (f"jax.devices() did not come up within {timeout}s "
+                   "(wedged device tunnel?)")
+  if probe.returncode != 0:
+    return False, (f"device probe exited with code {probe.returncode}: "
+                   f"{(probe.stderr or '').strip()[-500:]}")
+  if "cpu" in probe.stdout:
+    return False, "only CPU devices present (no TPU on this host)"
+  os.environ["KF_TPU_PROBE_RESULT"] = "ok"
+  return True, ""
+
+
 def setup(params):
   """Process-level setup (ref: benchmark_cnn.py:3356-3395).
 
@@ -199,6 +228,20 @@ def setup(params):
   platforms_util.initialize(params)
   platforms_util.get_cluster_manager(params)
   report_noop_parity_flags(params)
+  multi_process = (
+      len(params.worker_hosts or []) > 1 or
+      (params.num_processes or 1) > 1 or
+      int(os.environ.get("KFCOORD_WORLD") or 1) > 1)
+  if params.device == "tpu" and not multi_process:
+    # Fail loudly instead of hanging on a wedged device tunnel.
+    # Single-process only: in a kfrun / multi-worker launch, N probe
+    # subprocesses would contend with each other and the real workers
+    # for the exclusively-held chips.
+    ok, detail = tpu_reachable()
+    if not ok:
+      raise RuntimeError(
+          f"TPU backend unreachable: {detail}. Re-run with --device=cpu, "
+          "or retry once the TPU is reachable.")
   jax.devices()  # force backend init (ref dummy session :3383-3393)
   return params
 
