@@ -1,0 +1,96 @@
+"""Fetch an ImageNet subset and convert it to the framework's TFRecord
+layout (ref: scripts/tf_cnn_benchmarks/get_imagenet.py -- a tfds
+imagenet2012_subset loader).
+
+The reference script downloads `imagenet2012_subset/1pct` through
+tensorflow_datasets and inspects a few samples. This analog goes one
+step further and materializes the samples as `train-*` TFRecord shards
+in the layout `data/preprocessing.py` reads, so a downloaded subset is
+immediately trainable with `--data_dir`.
+
+tensorflow_datasets (and network egress) are not part of the baked
+environment; the import is gated with a clear error. On air-gapped
+hosts, use `data/get_tf_record.py` to convert a local JPEG directory
+instead.
+
+Run: python -m kf_benchmarks_tpu.data.get_imagenet \
+         --out_dir=/tmp/imagenet_subset --num_samples=1000
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import os
+
+
+def fetch(out_dir: str, num_samples: int = 1000, shards: int = 8,
+          subset: str = "imagenet2012_subset/1pct") -> int:
+  """Download `num_samples` images via tfds and write TFRecord shards.
+
+  Returns the number of examples written.
+  """
+  try:
+    import tensorflow_datasets as tfds  # noqa: PLC0415
+  except ImportError as e:
+    raise SystemExit(
+        "get_imagenet requires tensorflow_datasets (and network egress), "
+        "which this environment does not provide. On an air-gapped host, "
+        "convert a local JPEG directory with "
+        "`python -m kf_benchmarks_tpu.data.get_tf_record` instead."
+    ) from e
+  import numpy as np  # noqa: PLC0415
+  from PIL import Image  # noqa: PLC0415
+
+  from kf_benchmarks_tpu.data import example as example_lib  # noqa: PLC0415
+  from kf_benchmarks_tpu.data import tfrecord  # noqa: PLC0415
+
+  dataset = tfds.load(subset, split=f"train[:{num_samples}]",
+                      as_supervised=True)
+  os.makedirs(out_dir, exist_ok=True)
+  # Never more shards than samples (empty shards break shard rotation),
+  # and write to temp names so an interrupted download can't leave a
+  # complete-looking-but-truncated shard set for training to consume.
+  shards = max(1, min(shards, num_samples))
+  paths = [tfrecord.shard_path(out_dir, "train", i, shards)
+           for i in range(shards)]
+  writers = [tfrecord.TFRecordWriter(p + ".incomplete") for p in paths]
+  n = 0
+  try:
+    for image, label in tfds.as_numpy(dataset):
+      buf = io.BytesIO()
+      Image.fromarray(np.asarray(image)).save(buf, format="JPEG")
+      writers[n % shards].write(example_lib.encode_example({
+          # 1-based labels (0 = background), the layout the ImageNet
+          # Example parser expects (data/preprocessing.py).
+          "image/encoded": buf.getvalue(),
+          "image/class/label": np.asarray([int(label) + 1], np.int64),
+      }))
+      n += 1
+  except BaseException:
+    for w in writers:
+      w.close()
+    for p in paths:
+      if os.path.exists(p + ".incomplete"):
+        os.remove(p + ".incomplete")
+    raise
+  for w in writers:
+    w.close()
+  for p in paths:
+    os.replace(p + ".incomplete", p)
+  return n
+
+
+def main():
+  parser = argparse.ArgumentParser(description=__doc__)
+  parser.add_argument("--out_dir", required=True)
+  parser.add_argument("--num_samples", type=int, default=1000)
+  parser.add_argument("--shards", type=int, default=8)
+  parser.add_argument("--subset", default="imagenet2012_subset/1pct")
+  args = parser.parse_args()
+  n = fetch(args.out_dir, args.num_samples, args.shards, args.subset)
+  print(f"Wrote {n} examples to {args.out_dir}")
+
+
+if __name__ == "__main__":
+  main()

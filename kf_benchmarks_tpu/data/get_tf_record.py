@@ -1,10 +1,10 @@
 """JPEG directory -> TFRecord shard converter.
 
 The analog of the reference's data-fetch utilities
-(ref: scripts/tf_cnn_benchmarks/get_tf_record.py -- JPEG dir to TFRecord;
-get_imagenet.py -- tfds download, not reproducible here: this image has
-no network egress, so the converter consumes an already-downloaded
-ImageNet-layout directory instead).
+(ref: scripts/tf_cnn_benchmarks/get_tf_record.py -- JPEG dir to
+TFRecord). Its sibling ``data/get_imagenet.py`` covers the reference's
+tfds-download path (import-gated: this image has no network egress);
+this converter consumes an already-downloaded ImageNet-layout directory.
 
 Expected layout (the standard ImageNet raw layout):
 
@@ -65,8 +65,7 @@ def convert_subset(input_dir: str, output_dir: str, subset: str,
   per_shard = -(-len(files) // num_shards)  # ceil
   written = 0
   for shard in range(num_shards):
-    path = os.path.join(output_dir,
-                        f"{subset}-{shard:05d}-of-{num_shards:05d}")
+    path = tfrecord.shard_path(output_dir, subset, shard, num_shards)
     with tfrecord.TFRecordWriter(path) as w:
       for idx in order[shard * per_shard:(shard + 1) * per_shard]:
         fpath, label = files[idx]

@@ -110,6 +110,12 @@ def read_records(path: str, verify: bool = False) -> Iterator[bytes]:
       yield data
 
 
+def shard_path(data_dir: str, subset: str, index: int, total: int) -> str:
+  """Canonical shard filename, matched by :func:`list_shards`
+  (``<subset>-%05d-of-%05d``, the reference's naming convention)."""
+  return os.path.join(data_dir, f"{subset}-{index:05d}-of-{total:05d}")
+
+
 def list_shards(data_dir: str, subset: str) -> List[str]:
   """Shard discovery: ``<subset>-*-of-*`` files, the naming the reference's
   datasets use (ref: datasets.py:131-137 tf_record_pattern)."""

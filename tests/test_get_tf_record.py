@@ -64,3 +64,81 @@ def test_missing_subset_raises(tmp_path):
   with pytest.raises(ValueError, match="No train"):
     get_tf_record.convert_subset(str(tmp_path), str(tmp_path / "o"),
                                  "train", 1)
+
+
+def test_get_imagenet_gated_without_tfds():
+  """The tfds fetch utility (ref get_imagenet.py analog) exits with a
+  clear message when tensorflow_datasets is unavailable."""
+  import pytest as _pytest
+  from kf_benchmarks_tpu.data import get_imagenet
+  try:
+    import tensorflow_datasets  # noqa: F401
+    _pytest.skip("tfds present; gating not exercised")
+  except ImportError:
+    pass
+  with _pytest.raises(SystemExit, match="tensorflow_datasets"):
+    get_imagenet.fetch("/tmp/should_not_exist_imagenet")
+
+
+def test_get_imagenet_writes_readable_shards(tmp_path, monkeypatch):
+  """With tfds stubbed, fetch() writes train-* shards the framework's
+  TFRecord reader and Example parser round-trip."""
+  import io
+  import sys
+  import types
+  import numpy as np
+  from PIL import Image
+  from kf_benchmarks_tpu.data import example as example_lib
+  from kf_benchmarks_tpu.data import tfrecord
+
+  samples = [(np.full((8, 8, 3), 40 * i, np.uint8), i) for i in range(5)]
+  stub = types.ModuleType("tensorflow_datasets")
+  stub.load = lambda *a, **k: samples
+  stub.as_numpy = lambda ds: iter(ds)
+  monkeypatch.setitem(sys.modules, "tensorflow_datasets", stub)
+
+  from kf_benchmarks_tpu.data import get_imagenet
+  n = get_imagenet.fetch(str(tmp_path), num_samples=5, shards=2)
+  assert n == 5
+  shards = sorted(p.name for p in tmp_path.iterdir())
+  assert shards == ["train-00000-of-00002", "train-00001-of-00002"]
+  seen = []
+  for shard in shards:
+    for rec in tfrecord.read_records(str(tmp_path / shard), verify=True):
+      feats = example_lib.parse_example(rec)
+      label = int(np.asarray(feats["image/class/label"])[0])
+      img = Image.open(io.BytesIO(feats["image/encoded"][0]))
+      assert img.size == (8, 8)
+      seen.append(label)
+  assert sorted(seen) == [1, 2, 3, 4, 5]  # 1-based labels
+
+
+def test_get_imagenet_interrupted_fetch_leaves_no_shards(tmp_path,
+                                                         monkeypatch):
+  """A mid-download failure must not leave a complete-looking shard set
+  (training would silently consume truncated data); shards are also
+  capped at the sample count so no empty shards are written."""
+  import sys
+  import types
+  import numpy as np
+
+  def boom(ds):
+    yield (np.zeros((8, 8, 3), np.uint8), 0)
+    raise IOError("network dropped")
+
+  stub = types.ModuleType("tensorflow_datasets")
+  stub.load = lambda *a, **k: None
+  stub.as_numpy = boom
+  monkeypatch.setitem(sys.modules, "tensorflow_datasets", stub)
+  from kf_benchmarks_tpu.data import get_imagenet
+  import pytest as _pytest
+  with _pytest.raises(IOError):
+    get_imagenet.fetch(str(tmp_path), num_samples=10, shards=4)
+  assert list(tmp_path.iterdir()) == []
+
+  # shards capped at num_samples: 3 samples, 8 requested -> 3 shards.
+  samples = [(np.zeros((8, 8, 3), np.uint8), i) for i in range(3)]
+  stub.as_numpy = lambda ds: iter(samples)
+  n = get_imagenet.fetch(str(tmp_path), num_samples=3, shards=8)
+  assert n == 3
+  assert len(list(tmp_path.iterdir())) == 3
