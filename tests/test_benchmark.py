@@ -141,3 +141,40 @@ def test_eval_during_training_epoch_schedule_fires():
   for want_step, ai in zip([2, 5], acc_idx):
     prior = [s for i, s in step_of.items() if i < ai]
     assert prior and max(prior) == want_step, (want_step, logs)
+
+
+def test_tpu_reachable_paths(monkeypatch):
+  """tpu_reachable: success caches in env; CPU-only and nonzero-exit
+  and timeout report distinct diagnostics (the wedged-tunnel guard)."""
+  import subprocess
+  import types
+
+  monkeypatch.delenv("KF_TPU_PROBE_RESULT", raising=False)
+
+  def fake_run(stdout="", returncode=0, raise_timeout=False):
+    def run(*a, **k):
+      if raise_timeout:
+        raise subprocess.TimeoutExpired(cmd=a[0], timeout=k.get("timeout"))
+      return types.SimpleNamespace(returncode=returncode, stdout=stdout,
+                                   stderr="boom details")
+    return run
+
+  monkeypatch.setattr(subprocess, "run", fake_run(stdout="axon\n"))
+  ok, detail = benchmark.tpu_reachable()
+  assert ok and detail == ""
+  # Cached: a second call must not re-probe (subprocess would explode).
+  monkeypatch.setattr(subprocess, "run", fake_run(raise_timeout=True))
+  ok, _ = benchmark.tpu_reachable()
+  assert ok
+
+  monkeypatch.delenv("KF_TPU_PROBE_RESULT")
+  ok, detail = benchmark.tpu_reachable()
+  assert not ok and "did not come up" in detail
+
+  monkeypatch.setattr(subprocess, "run", fake_run(stdout="cpu\n"))
+  ok, detail = benchmark.tpu_reachable()
+  assert not ok and "no TPU on this host" in detail
+
+  monkeypatch.setattr(subprocess, "run", fake_run(returncode=1))
+  ok, detail = benchmark.tpu_reachable()
+  assert not ok and "boom details" in detail
