@@ -1,0 +1,58 @@
+"""MIGRATION.md freshness: every CLI command in the guide must parse
+and validate against the live flag corpus, so the migration guide can't
+drift from the implementation."""
+
+import os
+import re
+
+import pytest
+
+from kf_benchmarks_tpu import params as params_lib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _commands():
+  """Extract joined command lines from MIGRATION.md code blocks."""
+  with open(os.path.join(REPO, "MIGRATION.md")) as f:
+    text = f.read()
+  out = []
+  for block in re.findall(r"```bash\n(.*?)```", text, re.S):
+    joined = block.replace("\\\n", " ")
+    for line in joined.splitlines():
+      line = line.strip()
+      if line.startswith("python -m kf_benchmarks_tpu.cli"):
+        out.append(line)
+  return out
+
+
+def _flags_to_kwargs(cmd: str):
+  kwargs = {}
+  for tok in cmd.split()[3:]:  # drop "python -m kf_benchmarks_tpu.cli"
+    if not tok.startswith("--"):
+      continue
+    body = tok[2:]
+    if "=" in body:
+      k, v = body.split("=", 1)
+      kwargs[k] = v
+    elif body.startswith("no"):
+      kwargs[body[2:]] = False
+    else:
+      kwargs[body] = True
+  return kwargs
+
+
+COMMANDS = _commands()
+
+
+def test_migration_doc_has_commands():
+  assert len(COMMANDS) >= 8, COMMANDS
+
+
+@pytest.mark.parametrize("cmd", COMMANDS)
+def test_migration_commands_parse_and_validate(cmd):
+  if "${" in cmd or "..." in cmd:
+    pytest.skip("placeholder command")
+  kwargs = _flags_to_kwargs(cmd)
+  p = params_lib.make_params(**kwargs)  # raises on unknown/invalid flags
+  assert p.model
