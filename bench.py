@@ -28,8 +28,12 @@ def main():
 
   # Probe TPU availability out-of-process (a wedged TPU tunnel makes
   # jax.devices() block forever in-process, which must not hang the
-  # bench). Retry a few times before giving up -- a transient wedge at
-  # bench time must not turn the recorded metric into a CPU number. The
+  # bench). The probe timeout is deliberately FAR above worst-case claim
+  # latency: killing a probe mid-claim is itself the action that wedges
+  # the tunnel (PERF.md round-2 incident), so a live-but-slow claim must
+  # never be killed, and a timed-out probe must never be retried -- the
+  # retry would re-kill a client mid-claim and prolong the wedge. Only
+  # clean probe failures (process exited on its own) are retried. The
   # successful probe is cached in the env, so benchmark.setup() will
   # not re-probe.
   import time
@@ -37,20 +41,27 @@ def main():
     retries = max(1, int(os.environ.get("KF_BENCH_TPU_RETRIES", "3")))
   except ValueError:
     retries = 3
+  attempts = 0
+  detail = ""
   for attempt in range(retries):
+    attempts = attempt + 1
+    # Default timeout: KF_TPU_PROBE_TIMEOUT (600s), parsed inside
+    # tpu_reachable so there is exactly one copy of that logic.
     on_tpu, detail = benchmark.tpu_reachable()
     if on_tpu:
       break
-    print(f"TPU probe {attempt + 1}/{retries} failed ({detail})",
+    print(f"TPU probe {attempts}/{retries} failed ({detail})",
           file=sys.stderr, flush=True)
-    if "no TPU on this host" in detail:
+    if benchmark.PROBE_NO_TPU_MARKER in detail:
       break  # permanent condition; don't burn retries on it
-    if attempt + 1 < retries:
+    if benchmark.PROBE_TIMEOUT_MARKER in detail:
+      break  # timed-out probe was killed mid-claim; retrying re-kills
+    if attempts < retries:
       time.sleep(120)
   import jax
   if not on_tpu:
-    print(f"TPU unreachable after {retries} probes; falling back to CPU",
-          file=sys.stderr, flush=True)
+    print(f"TPU unreachable after {attempts} probe(s); last: {detail}; "
+          "falling back to CPU", file=sys.stderr, flush=True)
     jax.config.update("jax_platforms", "cpu")
   params = params_lib.make_params(
       model="resnet50",

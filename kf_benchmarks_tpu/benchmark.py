@@ -162,14 +162,31 @@ def report_noop_parity_flags(params) -> None:
              f"has no effect on TPU: {why}")
 
 
-def tpu_reachable(timeout: int = 120):
+# Machine-checkable probe-failure markers. bench.py's retry policy keys
+# on these (timeout => never retry: the killed probe is the action that
+# wedges the tunnel; no-TPU => permanent), so they are constants rather
+# than free-form text that could drift apart.
+PROBE_TIMEOUT_MARKER = "did not come up"
+PROBE_NO_TPU_MARKER = "no TPU on this host"
+
+
+def tpu_reachable(timeout: int | None = None):
   """Probe TPU backend liveness in a subprocess -> (ok, detail).
 
   A wedged device tunnel makes jax.devices() block forever in-process,
-  so the probe runs out-of-process with a timeout. A successful probe is
-  cached in the environment (inherited by children), so bench.py's
-  fallback check and setup()'s guard share one real probe per run.
+  so the probe runs out-of-process with a timeout. The default timeout
+  (KF_TPU_PROBE_TIMEOUT, 600s) sits far above worst-case claim latency
+  because killing a probe mid-claim is itself what wedges the tunnel --
+  callers must treat a timed-out probe as non-retryable. A successful
+  probe is cached in the environment (inherited by children), so
+  bench.py's fallback check and setup()'s guard share one real probe
+  per run.
   """
+  if timeout is None:
+    try:
+      timeout = int(os.environ.get("KF_TPU_PROBE_TIMEOUT", "600"))
+    except ValueError:
+      timeout = 600
   if os.environ.get("KF_TPU_PROBE_RESULT") == "ok":
     return True, ""
   import subprocess
@@ -180,13 +197,13 @@ def tpu_reachable(timeout: int = 120):
          "import jax; print(jax.devices()[0].platform)"],
         capture_output=True, text=True, timeout=timeout)
   except subprocess.TimeoutExpired:
-    return False, (f"jax.devices() did not come up within {timeout}s "
-                   "(wedged device tunnel?)")
+    return False, (f"jax.devices() {PROBE_TIMEOUT_MARKER} within "
+                   f"{timeout}s (wedged device tunnel?)")
   if probe.returncode != 0:
     return False, (f"device probe exited with code {probe.returncode}: "
                    f"{(probe.stderr or '').strip()[-500:]}")
   if "cpu" in probe.stdout:
-    return False, "only CPU devices present (no TPU on this host)"
+    return False, f"only CPU devices present ({PROBE_NO_TPU_MARKER})"
   os.environ["KF_TPU_PROBE_RESULT"] = "ok"
   return True, ""
 
@@ -370,7 +387,10 @@ class BenchmarkCNN:
     self._lr_fn = lr_fn
     return train_step_lib.make_step_fns(
         self.model, module, eval_module, self.strategy, tx, lr_fn, p,
-        self.mesh, compute_dtype=self.compute_dtype)
+        self.mesh, compute_dtype=self.compute_dtype,
+        # The RESOLVED step count (--num_batches default / --num_epochs
+        # derivation, _get_num_batches) -- params.num_batches may be None.
+        total_train_steps=self.num_batches)
 
   def _synthetic_global_batch(self, rng):
     """Device-resident synthetic inputs, sharded over replicas
@@ -580,6 +600,10 @@ class BenchmarkCNN:
     self.model.set_batch_size(batch_per_device)
     self.batch_size = batch_per_device * num_devices
     self.mesh = mesh_lib.build_mesh(num_devices, self.params.device)
+    # Rebuild the strategy: its reducer may capture topology-derived
+    # constants sized to the OLD axis (hierarchical_copy groups,
+    # planner replica hints), which would mis-permute on the new mesh.
+    self.strategy = strategies.get_strategy(self.params)
     # Epoch-based eval schedules are example counts; re-anchor their
     # step mapping to the new global batch size.
     self.eval_step_set = compute_eval_step_set(
@@ -698,6 +722,12 @@ class BenchmarkCNN:
         log_fn("Wrote cost analysis to %s (note: the analysis compiles "
                "the step once ahead of the jit cache's own compile)"
                % p.tfprof_file)
+        # The operator-facing top-op ranking the reference printed from
+        # tfprof (ref: benchmark_cnn.py:1208-1228).
+        table = observability.dump_per_op_profile(
+            compiled, p.tfprof_file + ".ops.txt")
+        for line in table.splitlines():
+          log_fn(line)
       if p.partitioned_graph_file_prefix:
         path = p.partitioned_graph_file_prefix + ".txt"
         observability.dump_partitioned_text(compiled, path)

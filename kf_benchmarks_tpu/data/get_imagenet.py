@@ -30,6 +30,31 @@ def fetch(out_dir: str, num_samples: int = 1000, shards: int = 8,
 
   Returns the number of examples written.
   """
+  # Refuse to mix shard generations BEFORE the tfds import gate: any
+  # leftover train-* file not part of THIS run's shard set (including a
+  # .incomplete orphan from a hard-killed run) would survive alongside
+  # the new set, and the reader's 'train-*' listing
+  # (data/tfrecord.py list_shards) would consume the union, silently
+  # training on duplicated or truncated data. This run's own names are
+  # exempt: its .incomplete temps are overwritten and its final names
+  # replaced atomically.
+  import glob  # noqa: PLC0415
+  from kf_benchmarks_tpu.data import tfrecord  # noqa: PLC0415
+  want_shards = max(1, min(shards, num_samples))
+  expected = set()
+  for i in range(want_shards):
+    base = os.path.basename(
+        tfrecord.shard_path(out_dir, "train", i, want_shards))
+    expected.add(base)
+    expected.add(base + ".incomplete")
+  stale = [p for p in glob.glob(os.path.join(out_dir, "train-*"))
+           if os.path.basename(p) not in expected]
+  if stale:
+    raise SystemExit(
+        f"{out_dir} already holds {len(stale)} train file(s) from a run "
+        f"with a different shard count (e.g. {os.path.basename(stale[0])}); "
+        "remove them first -- the reader lists every 'train-*' file and "
+        "would consume both generations.")
   try:
     import tensorflow_datasets as tfds  # noqa: PLC0415
   except ImportError as e:
@@ -51,7 +76,7 @@ def fetch(out_dir: str, num_samples: int = 1000, shards: int = 8,
   # Never more shards than samples (empty shards break shard rotation),
   # and write to temp names so an interrupted download can't leave a
   # complete-looking-but-truncated shard set for training to consume.
-  shards = max(1, min(shards, num_samples))
+  shards = want_shards
   paths = [tfrecord.shard_path(out_dir, "train", i, shards)
            for i in range(shards)]
   writers = [tfrecord.TFRecordWriter(p + ".incomplete") for p in paths]

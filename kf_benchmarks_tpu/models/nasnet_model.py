@@ -9,10 +9,12 @@ concatenation, factorized reduction) is re-expressed as one compact flax
 module; separable convs lower to depthwise+pointwise pairs that XLA
 fuses, and all shapes are static so the whole network tiles onto the MXU.
 
-Simplification vs reference: drop-path keep-prob uses the cell-depth
-schedule but not the global-step ramp (the reference divides by
-total_training_steps, nasnet_utils.py:407-439); benchmark runs are far
-shorter than a convergence run, where the ramp is ~1 anyway.
+Drop-path keep-prob composes the cell-depth schedule with the
+global-step ramp (ref: nasnet_utils.py:407-439): the trainer passes
+``progress = step / total_training_steps`` into ``__call__`` and the
+ramp scales the drop rate from 0 at step 0 to its full value at the end
+of training. Without a ``progress`` argument (e.g. eval), only the
+cell-depth schedule applies.
 
 Zoph et al., "Learning Transferable Architectures for Scalable Image
 Recognition" (arXiv:1707.07012).
@@ -50,6 +52,20 @@ def calc_reduction_layers(num_cells: int,
   """Cell indices where reduction cells go (ref: nasnet_utils.py:44-51)."""
   return [int(float(pool_num) / (num_reduction_layers + 1) * num_cells)
           for pool_num in range(1, num_reduction_layers + 1)]
+
+
+def drop_path_keep_prob(base_keep_prob: float, cell_num: int,
+                        total_cells: int, progress=None):
+  """Keep probability after the cell-depth schedule and the global-step
+  ramp (ref: nasnet_utils.py:407-439): deeper cells drop more, and the
+  drop rate ramps linearly with training progress (clamped at 1) so
+  early training sees keep_prob ~ 1."""
+  layer_ratio = (cell_num + 1) / float(total_cells)
+  keep = 1.0 - layer_ratio * (1.0 - base_keep_prob)
+  if progress is not None:
+    ratio = jnp.minimum(1.0, progress)
+    keep = 1.0 - ratio * (1.0 - keep)
+  return keep
 
 
 def _op_info(operation: str) -> Tuple[int, int]:
@@ -134,18 +150,18 @@ class NasnetModule(nn.Module):
     path2 = self._conv(path2, output_filters - output_filters // 2, 1)
     return self._bn(jnp.concatenate([path1, path2], axis=-1))
 
-  def _drop_path(self, x, cell_num, total_cells):
-    """Whole-example drop with cell-depth-scaled keep prob
+  def _drop_path(self, x, cell_num, total_cells, progress=None):
+    """Whole-example drop with cell-depth- and progress-scaled keep prob
     (ref: nasnet_utils.py:134-145 drop_path, :406-439 schedule)."""
-    keep_prob = self.drop_path_keep_prob
-    if not self.phase_train or keep_prob >= 1.0 or cell_num < 0:
+    if (not self.phase_train or self.drop_path_keep_prob >= 1.0 or
+        cell_num < 0):
       return x
-    layer_ratio = (cell_num + 1) / float(total_cells)
-    keep_prob = 1.0 - layer_ratio * (1.0 - keep_prob)
+    keep_prob = jnp.asarray(drop_path_keep_prob(
+        self.drop_path_keep_prob, cell_num, total_cells, progress), x.dtype)
     rng = self.make_rng("dropout")
     noise = keep_prob + jax.random.uniform(
         rng, (x.shape[0], 1, 1, 1), x.dtype)
-    return x / jnp.asarray(keep_prob, x.dtype) * jnp.floor(noise)
+    return x / keep_prob * jnp.floor(noise)
 
   # -- cell -----------------------------------------------------------------
 
@@ -164,7 +180,7 @@ class NasnetModule(nn.Module):
     return prev
 
   def _apply_op(self, x, operation, stride, is_from_original_input,
-                filter_size, cell_num, total_cells):
+                filter_size, cell_num, total_cells, progress=None):
     """(ref: nasnet_utils.py:350-377)."""
     if stride > 1 and not is_from_original_input:
       stride = 1
@@ -184,11 +200,12 @@ class NasnetModule(nn.Module):
     else:
       raise ValueError(f"Unimplemented operation {operation}")
     if operation != "none":
-      x = self._drop_path(x, cell_num, total_cells)
+      x = self._drop_path(x, cell_num, total_cells, progress)
     return x
 
   def _cell(self, x, prev, operations, used_hiddenstates,
-            hiddenstate_indices, filter_size, stride, cell_num, total_cells):
+            hiddenstate_indices, filter_size, stride, cell_num, total_cells,
+            progress=None):
     """One NASNet-A cell (ref: nasnet_utils.py:284-348)."""
     prev = self._reduce_prev_layer(prev, x, filter_size)
     h = nn.relu(x)
@@ -198,9 +215,9 @@ class NasnetModule(nn.Module):
     for it in range(5):
       li, ri = hiddenstate_indices[2 * it], hiddenstate_indices[2 * it + 1]
       h1 = self._apply_op(states[li], operations[2 * it], stride, li < 2,
-                          filter_size, cell_num, total_cells)
+                          filter_size, cell_num, total_cells, progress)
       h2 = self._apply_op(states[ri], operations[2 * it + 1], stride, ri < 2,
-                          filter_size, cell_num, total_cells)
+                          filter_size, cell_num, total_cells, progress)
       states.append(h1 + h2)
     # Concat states not consumed by any combination
     # (ref: nasnet_utils.py:377-405).
@@ -233,7 +250,7 @@ class NasnetModule(nn.Module):
   # -- network --------------------------------------------------------------
 
   @nn.compact
-  def __call__(self, images):
+  def __call__(self, images, progress=None):
     x = images.astype(self.dtype)
     reduction_indices = calc_reduction_layers(self.num_cells,
                                               self.num_reduction_layers)
@@ -255,7 +272,7 @@ class NasnetModule(nn.Module):
             x, cell_outputs[-2], REDUCTION_OPERATIONS,
             REDUCTION_USED_HIDDENSTATES, REDUCTION_HIDDENSTATE_INDICES,
             int(self.num_conv_filters * filter_scaling), 2, true_cell_num,
-            total_cells)
+            total_cells, progress)
         cell_outputs.append(x)
         filter_scaling *= self.filter_scaling_rate
         true_cell_num += 1
@@ -277,7 +294,7 @@ class NasnetModule(nn.Module):
             x, cell_outputs[-2], REDUCTION_OPERATIONS,
             REDUCTION_USED_HIDDENSTATES, REDUCTION_HIDDENSTATE_INDICES,
             int(self.num_conv_filters * filter_scaling), 2, true_cell_num,
-            total_cells)
+            total_cells, progress)
         true_cell_num += 1
         cell_outputs.append(x)
       if not self.skip_reduction_layer_input:
@@ -286,7 +303,7 @@ class NasnetModule(nn.Module):
           x, prev_layer, NORMAL_OPERATIONS, NORMAL_USED_HIDDENSTATES,
           NORMAL_HIDDENSTATE_INDICES,
           int(self.num_conv_filters * filter_scaling), 1, true_cell_num,
-          total_cells)
+          total_cells, progress)
       true_cell_num += 1
       if (self.use_aux_head and cell_num == aux_head_cell_idx and
           self.phase_train):

@@ -30,6 +30,7 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import re
 import time
 from typing import Any, Dict, Optional
 
@@ -112,6 +113,195 @@ def dump_cost_analysis(lowered, path: str,
   with open(path, "w") as f:
     json.dump(report, f, indent=2, sort_keys=True)
   return report
+
+
+# -- per-op profile table (ref: benchmark_cnn.py:1208-1228 tfprof) ----------
+
+# Roofline constants for the estimated-time ranking (TPU v5e: ~197 Tflop/s
+# bf16 MXU peak, ~819 GB/s HBM). Only the RANKING depends on these; both
+# raw flops and bytes are printed so an operator can re-derive times for
+# any chip.
+TPU_PEAK_FLOPS = 197e12
+TPU_PEAK_BYTES_PER_S = 819e9
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s64": 8, "u64": 8, "s32": 4, "u32": 4, "s16": 2, "u16": 2,
+    "s8": 1, "u8": 1, "pred": 1, "c64": 8, "c128": 16,
+}
+_SHAPE_RE = re.compile(r"\b([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+
+def _shapes_bytes(text: str) -> int:
+  total = 0
+  for dtype, dims in _SHAPE_RE.findall(text):
+    if dtype not in _DTYPE_BYTES:
+      continue
+    elems = 1
+    for d in dims.split(","):
+      if d:
+        elems *= int(d)
+    total += elems * _DTYPE_BYTES[dtype]
+  return total
+
+
+def _shape_dims(text: str):
+  m = _SHAPE_RE.search(text)
+  if not m:
+    return []
+  return [int(d) for d in m.group(2).split(",") if d]
+
+
+def _split_operands(operand_text: str):
+  """Split a top-level-comma operand list (shapes contain commas too)."""
+  parts, depth, cur = [], 0, []
+  for ch in operand_text:
+    if ch in "([{":
+      depth += 1
+    elif ch in ")]}":
+      depth -= 1
+    if ch == "," and depth == 0:
+      parts.append("".join(cur))
+      cur = []
+    else:
+      cur.append(ch)
+  if cur:
+    parts.append("".join(cur))
+  return parts
+
+
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?(%[\w.\-]+)\s*=\s*(.*?)\s([a-z][a-z0-9\-]*)\(")
+
+
+def _instr_flops(opcode: str, result_type: str, operands, attrs: str) -> float:
+  """MXU-op flop estimate from shapes (convolution / dot); everything
+  else is treated as bandwidth-bound (0 flops)."""
+  out_elems = 1
+  for d in _shape_dims(result_type):
+    out_elems *= d
+  if opcode == "convolution" and len(operands) >= 2:
+    # flops = 2 * out_elems * prod(kernel_spatial) * Cin_per_group, with
+    # the kernel's spatial and input-feature dims located via dim_labels
+    # (rhs labels: digits = spatial, 'i' = input features). HLO kernel
+    # shapes already carry Cin/feature_group_count on the 'i' dim, so no
+    # further group division (a depthwise conv's 'i' dim is 1).
+    rhs_dims = _shape_dims(operands[1])
+    m = re.search(r"dim_labels=[^_]+_([\w]+)->", attrs)
+    if not m or not rhs_dims:
+      return 0.0
+    rhs_labels = m.group(1)
+    if len(rhs_labels) != len(rhs_dims):
+      return 0.0
+    kernel_elems_per_out = 1
+    for label, dim in zip(rhs_labels, rhs_dims):
+      if label.isdigit() or label == "i":
+        kernel_elems_per_out *= dim
+    return 2.0 * out_elems * kernel_elems_per_out
+  if opcode == "dot" and operands:
+    lhs_dims = _shape_dims(operands[0])
+    m = re.search(r"lhs_contracting_dims=\{([0-9,]*)\}", attrs)
+    if not m or not lhs_dims:
+      return 0.0
+    contracted = 1
+    for idx in m.group(1).split(","):
+      if idx and int(idx) < len(lhs_dims):
+        contracted *= lhs_dims[int(idx)]
+    return 2.0 * out_elems * contracted
+  return 0.0
+
+
+def per_op_costs(hlo_text: str):
+  """Per-instruction cost rows from an optimized-HLO text dump.
+
+  Walks every computation EXCEPT fusion bodies (a fusion instruction
+  already accounts for its body's memory traffic; convs/dots stay
+  top-level on TPU), estimating flops for MXU ops and bytes for all, and
+  a roofline time estimate. Occurrence counts are static (a while-loop
+  body is counted once, not trip-count-weighted)."""
+  # Pass 1: name -> result type. Optimized HLO prints operands as bare
+  # %names (no inline types), so operand shapes resolve through this
+  # symbol table.
+  types = {}
+  for line in hlo_text.splitlines():
+    m = _INSTR_RE.match(line)
+    if m:
+      types[m.group(1)] = m.group(2)
+
+  def _resolve(operand: str) -> str:
+    if _SHAPE_RE.search(operand):  # unoptimized dumps inline the type
+      return operand
+    nm = re.search(r"%[\w.\-]+", operand)
+    return types.get(nm.group(0), "") if nm else ""
+
+  rows = []
+  in_fusion_body = False
+  for line in hlo_text.splitlines():
+    stripped = line.strip()
+    if stripped.endswith("{") and stripped.startswith("%fused_"):
+      in_fusion_body = True
+      continue
+    if stripped == "}" or stripped.startswith("} "):
+      in_fusion_body = False
+      continue
+    if in_fusion_body:
+      continue
+    m = _INSTR_RE.match(line)
+    if not m:
+      continue
+    name, result_type, opcode = m.groups()
+    if opcode in ("parameter", "constant", "tuple", "get-tuple-element",
+                  "bitcast", "after-all"):
+      continue
+    # Balanced-paren scan for the operand list (attrs may contain parens).
+    start = m.end()
+    depth, i = 1, start
+    while i < len(line) and depth:
+      if line[i] == "(":
+        depth += 1
+      elif line[i] == ")":
+        depth -= 1
+      i += 1
+    operand_text, attrs = line[start:i - 1], line[i:]
+    operands = [_resolve(op) for op in _split_operands(operand_text)]
+    flops = _instr_flops(opcode, result_type, operands, attrs)
+    nbytes = _shapes_bytes(result_type) + sum(
+        _shapes_bytes(op) for op in operands)
+    est_s = max(flops / TPU_PEAK_FLOPS, nbytes / TPU_PEAK_BYTES_PER_S)
+    rows.append({"name": name, "opcode": opcode, "flops": flops,
+                 "bytes": nbytes, "est_time_s": est_s})
+  return rows
+
+
+PER_OP_TABLE_HEADER = ("rank  est_time_us  %total        flops"
+                       "        bytes  op")
+
+
+def per_op_table(hlo_text: str, top_n: int = 20) -> str:
+  """The tfprof top-op table analog (ref: benchmark_cnn.py:1208-1228
+  prints the top-20 ops by accelerator time): top-``top_n`` HLO
+  instructions by roofline-estimated device time."""
+  rows = per_op_costs(hlo_text)
+  rows.sort(key=lambda r: r["est_time_s"], reverse=True)
+  total = sum(r["est_time_s"] for r in rows) or 1.0
+  lines = [f"Top {top_n} ops by estimated accelerator time "
+           "(static roofline on the compiled HLO)",
+           PER_OP_TABLE_HEADER]
+  for rank, r in enumerate(rows[:top_n], 1):
+    lines.append(
+        f"{rank:4d}  {r['est_time_s'] * 1e6:11.1f}  "
+        f"{100.0 * r['est_time_s'] / total:5.1f}%  {r['flops']:11.3e}  "
+        f"{r['bytes']:11.3e}  {r['name']} {r['opcode']}")
+  return "\n".join(lines)
+
+
+def dump_per_op_profile(compiled, path: str, top_n: int = 20) -> str:
+  """Write the per-op table next to the tfprof cost JSON and return it."""
+  table = per_op_table(compiled.as_text(), top_n=top_n)
+  os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+  with open(path, "w") as f:
+    f.write(table + "\n")
+  return table
 
 
 # -- benchmark logger (ref: benchmark_cnn.py:1594-1608) ---------------------

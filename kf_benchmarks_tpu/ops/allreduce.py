@@ -193,37 +193,79 @@ def _rsag(vec, axis_name, shards=1):
   return vec / n
 
 
-def _hier(vec, axis_name, num_groups=2):
-  """Two-level hierarchical reduction over ``num_groups`` contiguous
-  groups: a ring all-reduce within each group (intra-host ICI on a
-  (host,chip) device order), then a stride-``group_size`` ring across the
-  groups -- (g-1) + (num_groups-1) exchange rounds instead of a flat
+def topology_groups(devices, num_groups: Optional[int] = None):
+  """Axis-position -> group id for hierarchical reduction, derived from
+  real machine topology the way the reference's HierarchicalCopy encodes
+  it (ref: batch_allreduce.py:173-267 topology tables).
+
+  ``devices`` is the mesh axis's device order. Multi-process: groups are
+  the process (host) boundaries, so the intra-group ring rides ICI and
+  only the cross-group ring crosses DCN. Single-process (no topology to
+  read): contiguous split into ``num_groups`` (default 2, the reference's
+  two-group HierarchicalCopy shape)."""
+  procs = [getattr(d, "process_index", 0) for d in devices]
+  uniq = sorted(set(procs))
+  if len(uniq) > 1:
+    gid = {p: i for i, p in enumerate(uniq)}
+    return [gid[p] for p in procs]
+  n = len(devices)
+  k = max(2, int(num_groups or 2))
+  if n % k != 0:
+    return [0] * n  # degenerate; _hier falls back to pmean
+  return [i // (n // k) for i in range(n)]
+
+
+def _ring_sum(vec, axis_name, cycles, rounds):
+  """Sum values around disjoint position cycles: ``rounds`` applications
+  of the cycles' successor permutation, accumulating each arrival."""
+  perm = []
+  for cycle in cycles:
+    for j, pos in enumerate(cycle):
+      perm.append((pos, cycle[(j + 1) % len(cycle)]))
+  acc, cur = vec, vec
+  for _ in range(rounds):
+    cur = lax.ppermute(cur, axis_name, perm)
+    acc = acc + cur
+  return acc
+
+
+def _hier(vec, axis_name, num_groups=2, groups=None):
+  """Two-level hierarchical reduction: a ring all-reduce within each
+  group (intra-host ICI), then a ring across same-offset members of each
+  group -- (g-1) + (num_groups-1) exchange rounds instead of a flat
   ring's n-1 (the analog of the reference's two-group reduce ->
   cross-group reduce -> broadcast HierarchicalCopy,
   batch_allreduce.py:173-267, and 'nccl/rechd',
-  allreduce_legacy.py:344-348). Falls back to a direct pmean when the
-  axis does not divide evenly."""
+  allreduce_legacy.py:344-348).
+
+  ``groups`` maps axis position -> group id (from :func:`topology_groups`,
+  i.e. process/host boundaries); absent, groups are ``num_groups``
+  contiguous blocks. Falls back to a direct pmean when groups are not
+  equal-sized (the reference requires symmetric topology too)."""
   n = lax.axis_size(axis_name)
-  num_groups = max(2, int(num_groups))
-  if n <= 1 or n % num_groups != 0:
+  if groups is not None and len(groups) != n:
+    # Stale topology capture (e.g. a reducer built for a different mesh
+    # surviving an elastic resize): permuting with wrong-length groups
+    # would drop or zero replicas, so reduce flat instead.
+    groups = None
+  if groups is None:
+    num_groups = max(2, int(num_groups))
+    if n <= 1 or n % num_groups != 0:
+      return lax.pmean(vec, axis_name)
+    groups = [i // (n // num_groups) for i in range(n)]
+  members = {}
+  for pos, g in enumerate(groups):
+    members.setdefault(g, []).append(pos)
+  sizes = {len(m) for m in members.values()}
+  if n <= 1 or len(members) < 2 or len(sizes) != 1:
     return lax.pmean(vec, axis_name)
-  gsize = n // num_groups
-
-  def ring_accumulate(v, stride, rounds, block):
-    """Accumulate values around a rotate-by-``stride`` ring confined to
-    contiguous blocks of ``block`` devices."""
-    acc, cur = v, v
-    perm = []
-    for i in range(n):
-      base = (i // block) * block
-      perm.append((i, base + (i - base + stride) % block))
-    for _ in range(rounds):
-      cur = lax.ppermute(cur, axis_name, perm)
-      acc = acc + cur
-    return acc
-
-  vec = ring_accumulate(vec, 1, gsize - 1, gsize)     # intra-group sum
-  vec = ring_accumulate(vec, gsize, num_groups - 1, n)  # cross-group sum
+  gsize = sizes.pop()
+  ordered = [members[g] for g in sorted(members)]
+  # Intra-group rings (one cycle per group), then cross-group rings (one
+  # cycle per member offset, linking the j-th member of every group).
+  vec = _ring_sum(vec, axis_name, ordered, gsize - 1)
+  cross = [[grp[j] for grp in ordered] for j in range(gsize)]
+  vec = _ring_sum(vec, axis_name, cross, len(ordered) - 1)
   return vec / n
 
 
@@ -359,15 +401,17 @@ def repack_reduce(grads, axis_name, num_chunks: int, num_replicas: int,
                                       unpack_tensors(vec, meta))
 
 
-def hier_reduce(grads, axis_name, num_groups: int = 2, compact_dtype=None):
+def hier_reduce(grads, axis_name, num_groups: int = 2, compact_dtype=None,
+                groups=None):
   """Default-path two-level reduction (ref: --hierarchical_copy,
   batch_allreduce.py:173-267 HierarchicalCopy): on TPU, a grouped psum
-  within contiguous device groups then across them."""
+  within device groups (process/host boundaries via ``groups``, else
+  contiguous) then across them."""
   def one(x):
     orig = x.dtype
     if compact_dtype is not None and x.dtype != compact_dtype:
       x = x.astype(compact_dtype)
-    return _hier(x, axis_name, num_groups).astype(orig)
+    return _hier(x, axis_name, num_groups, groups=groups).astype(orig)
   return jax.tree.map(one, grads)
 
 
@@ -392,8 +436,16 @@ def build_reducer(params):
         g, ax, params.agg_small_grads_max_bytes,
         params.agg_small_grads_max_group, params.num_devices, compact)
   if params.hierarchical_copy:
-    return lambda g, ax: hier_reduce(g, ax, num_groups=2,
-                                     compact_dtype=compact)
+    # Groups come from real topology (process/host boundaries) on a
+    # multi-process mesh, so the intra-group ring rides ICI; num_groups
+    # defaults to the process count there and to the reference's 2-group
+    # shape single-process (ref: batch_allreduce.py:173-267).
+    from kf_benchmarks_tpu.parallel import mesh as mesh_lib
+    devices = mesh_lib.get_devices(params.device, params.num_devices)
+    groups = topology_groups(devices, num_groups=jax.process_count()
+                             if jax.process_count() > 1 else 2)
+    return lambda g, ax: hier_reduce(g, ax, compact_dtype=compact,
+                                     groups=groups)
   return None
 
 

@@ -109,6 +109,12 @@ def gossip_shift(step, axis_size: int):
   return 1 + jnp.asarray(step) % (axis_size - 1)
 
 
+# Axis size at or below which pair_average bakes all shifts into a
+# lax.switch (one send per step); above it, gated power-of-two hops keep
+# the program O(log n) at the cost of up to log2(n) sends per step.
+GOSSIP_SWITCH_MAX_N = 8
+
+
 def pair_average(tree, step, axis_name: str = REPLICA_AXIS):
   """One gossip round: average weights with the step's partner
   (KungFu PairAveragingOptimizer data plane -> ppermute).
@@ -116,23 +122,46 @@ def pair_average(tree, step, axis_name: str = REPLICA_AXIS):
   Each replica i receives from (i - shift) mod n and averages. This is the
   row-stochastic gossip matrix W = (I + P)/2 with P a cyclic permutation:
   doubly stochastic, so the network average is preserved exactly -- the
-  property AD-PSGD's analysis needs.
+  property AD-PSGD's analysis needs. Both lowerings below compute the
+  identical permutation, so results are bit-equal across the threshold.
   """
   n = lax.axis_size(axis_name)
   if n == 1:
     return tree
-  # All possible cyclic-shift permutations are baked into a switch so the
-  # partner can vary per step without retracing (static perm lists).
-  def make_branch(shift):
-    perm = [(i, (i + shift) % n) for i in range(n)]
-    def branch(t):
-      return jax.tree.map(
-          lambda x: 0.5 * (x + lax.ppermute(x, axis_name, perm)), t)
-    return branch
-
-  branches = [make_branch(s) for s in range(1, n)]
-  shift = gossip_shift(step, n)
-  return lax.switch(jnp.asarray(shift - 1, jnp.int32), branches, tree)
+  shift = jnp.asarray(gossip_shift(step, n), jnp.int32)
+  if n <= GOSSIP_SWITCH_MAX_N:
+    # Small axes: bake each cyclic shift as a switch branch -- exactly
+    # ONE tree-sized send per gossip step, at n-1 branches of program.
+    def make_branch(s):
+      perm = [(i, (i + s) % n) for i in range(n)]
+      return lambda t: jax.tree.map(
+          lambda x: lax.ppermute(x, axis_name, perm), t)
+    shifted = lax.switch(shift - 1, [make_branch(s) for s in range(1, n)],
+                         tree)
+  else:
+    # At scale the cyclic shift decomposes into gated power-of-two hops
+    # (binary digits of the shift), so the program holds ceil(log2 n)
+    # static ppermutes instead of n-1 switch branches (n=256 would bake
+    # 255). The trade is wire traffic: every hop sends the full tree and
+    # the gate discards unused hops, so a gossip step costs up to
+    # ceil(log2 n) tree-sized sends where the switch costs one -- paid
+    # only above the threshold, where the O(n^2) program would be worse.
+    # ppermute moves data without arithmetic, so the composed result is
+    # bit-identical to a single shift-s permutation; the partner still
+    # varies per step without retracing (the gates read the shift's
+    # bits).
+    shifted = tree
+    for k in range((n - 1).bit_length()):
+      # hop is never 0 mod n: for power-of-two n every 1<<k here is < n,
+      # and otherwise n has an odd factor no power of two divides.
+      hop = (1 << k) % n
+      perm = [(i, (i + hop) % n) for i in range(n)]
+      take_hop = ((shift >> k) & 1).astype(jnp.bool_)
+      shifted = jax.tree.map(
+          lambda x, p=perm: jnp.where(
+              take_hop, lax.ppermute(x, axis_name, p), x),
+          shifted)
+  return jax.tree.map(lambda x, y: 0.5 * (x + y), tree, shifted)
 
 
 def sync_average(tree, axis_name: str = REPLICA_AXIS):

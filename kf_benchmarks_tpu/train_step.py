@@ -85,10 +85,13 @@ def l2_loss(params, single_op: bool = False):
 
 
 def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
-                  mesh, compute_dtype=jnp.float32):
+                  mesh, compute_dtype=jnp.float32, total_train_steps=None):
   """Build (init_fn, train_step, eval_step) jitted over ``mesh``.
 
   All three operate on per-replica stacked state (leading replica dim).
+  ``total_train_steps`` is the RESOLVED run length (callers must pass the
+  derived count -- params.num_batches is None on default/--num_epochs
+  runs); it drives progress-ramped modules (NASNet drop-path).
   """
   num_replicas = mesh.devices.size
   weight_decay = params.weight_decay or 0.0
@@ -115,6 +118,15 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
       loss_scale_normal_steps=P(), rng=P(), buffers=P(REPLICA_AXIS))
   staged_vars = bool(getattr(params, "staged_vars", False))
   relaxed = getattr(params, "variable_consistency", "strong") == "relaxed"
+  # Modules with a training-progress schedule (NASNet drop-path's
+  # global-step ramp, ref: nasnet_utils.py:407-439) take ``progress`` =
+  # step / total_training_steps; total steps is the run's --num_batches.
+  import inspect
+  module_takes_progress = (
+      "progress" in inspect.signature(type(module).__call__).parameters)
+  if total_train_steps is None:
+    total_train_steps = int(getattr(params, "num_batches", None) or 0)
+  total_train_steps = int(total_train_steps)
 
   def _squeeze(tree):
     return jax.tree.map(lambda x: jnp.squeeze(x, axis=0), tree)
@@ -171,13 +183,18 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
     step_rng = jax.random.fold_in(
         jax.random.fold_in(state.rng, state.step), replica_id)
 
+    apply_kwargs = {}
+    if module_takes_progress and total_train_steps > 0:
+      apply_kwargs["progress"] = (
+          state.step.astype(jnp.float32) / total_train_steps)
+
     def loss_fn(p):
       variables = {"params": p}
       if batch_stats:
         variables["batch_stats"] = batch_stats
       (logits, aux_logits), updates = module.apply(
           variables, images, mutable=["batch_stats"],
-          rngs={"dropout": step_rng})
+          rngs={"dropout": step_rng}, **apply_kwargs)
       new_bs = updates.get("batch_stats", batch_stats)
       from kf_benchmarks_tpu.models.model import BuildNetworkResult
       result = BuildNetworkResult(logits=(logits, aux_logits))

@@ -14,19 +14,31 @@ import jax
 def drain(tree) -> None:
   """Block until all device work feeding ``tree`` has completed.
 
-  Fetches every addressable shard of the smallest array leaf, keeping
-  the host transfer negligible. Per-device execution is in-order, so
-  once each device's shard of the leaf is fetched, everything enqueued
-  on that device before the leaf's producer has completed. Fetching all
-  shards (not the assembled array) matters for replicated leaves, where
-  assembling would read one device and leave the others' queues live.
+  Fetches every addressable shard of the smallest array leaf *per
+  distinct device set*, keeping the host transfer negligible. Per-device
+  execution is in-order, so once each device's shard of a leaf is
+  fetched, everything enqueued on that device before the leaf's producer
+  has completed. Fetching all shards (not the assembled array) matters
+  for replicated leaves, where assembling would read one device and
+  leave the others' queues live. Grouping by device set matters when a
+  tree mixes differently-committed leaves (e.g. a single-device scalar
+  alongside mesh-sharded arrays): draining only the globally smallest
+  leaf would leave the other devices' queues live.
   """
   leaves = [l for l in jax.tree.leaves(tree) if hasattr(l, "dtype")]
   if not leaves:
     return
-  leaf = min(leaves, key=lambda x: x.size)
-  shards = getattr(leaf, "addressable_shards", None)
-  if shards:
-    jax.device_get([s.data for s in shards])
-  else:
-    jax.device_get(leaf)
+  by_devices = {}
+  for leaf in leaves:
+    shards = getattr(leaf, "addressable_shards", None)
+    devices = (frozenset(s.device.id for s in shards) if shards
+               else frozenset())
+    best = by_devices.get(devices)
+    if best is None or leaf.size < best.size:
+      by_devices[devices] = leaf
+  for leaf in by_devices.values():
+    shards = getattr(leaf, "addressable_shards", None)
+    if shards:
+      jax.device_get([s.data for s in shards])
+    else:
+      jax.device_get(leaf)

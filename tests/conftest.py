@@ -26,3 +26,6 @@ jax.config.update("jax_platforms", "cpu")
 
 def pytest_configure(config):
   config.addinivalue_line("markers", "slow: long-running test")
+  config.addinivalue_line(
+      "markers", "distributed: spawns subprocess workers (also selectable "
+      "with -m distributed; cheap ones run in the default suite)")

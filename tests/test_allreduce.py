@@ -1,6 +1,8 @@
 """Tests for the collectives layer: spec parsing, packing round-trips,
 planner numerics (ref: allreduce_test.py:32-446)."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -11,6 +13,7 @@ from kf_benchmarks_tpu.ops import allreduce
 from kf_benchmarks_tpu.parallel.mesh import build_mesh
 
 N = 8
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 class TestSpecParsing:
@@ -96,6 +99,138 @@ def test_size_ranged_bucketing():
   assert planner._bucket_of(8) == 0
   assert planner._bucket_of(400) == 1
   assert planner._bucket_of(32) == 1  # exclusive upper bound
+
+
+class _FakeDev:
+  def __init__(self, process_index):
+    self.process_index = process_index
+
+
+def test_topology_groups_follow_process_boundaries():
+  """Multi-process device lists group by process (host) so the intra
+  ring rides ICI; single-process falls back to a contiguous split
+  (ref: batch_allreduce.py:173-267 topology tables; VERDICT r2 #5)."""
+  devs = [_FakeDev(p) for p in (0, 0, 1, 1, 3, 3)]
+  assert allreduce.topology_groups(devs) == [0, 0, 1, 1, 2, 2]
+  # Single-process: contiguous num_groups split.
+  devs = [_FakeDev(0)] * 8
+  assert allreduce.topology_groups(devs, 2) == [0, 0, 0, 0, 1, 1, 1, 1]
+  assert allreduce.topology_groups(devs, 4) == [0, 0, 1, 1, 2, 2, 3, 3]
+  # Indivisible -> degenerate single group (pmean fallback in _hier).
+  assert allreduce.topology_groups([_FakeDev(0)] * 6, 4) == [0] * 6
+
+
+@pytest.mark.parametrize("groups", [
+    [0, 0, 0, 0, 1, 1, 1, 1],   # contiguous (2 hosts x 4 chips)
+    [0, 1, 0, 1, 0, 1, 0, 1],   # interleaved (non-contiguous positions)
+    [0, 0, 1, 1, 2, 2, 3, 3],   # 4 groups of 2
+    [2, 0, 1, 1, 0, 2, 0, 1, 2, 0, 1, 2][:8],  # scrambled ids
+])
+def test_hier_reduce_with_topology_groups_matches_pmean(groups):
+  """The grouped two-level ring must equal a flat pmean for any
+  equal-size group assignment, contiguous or not."""
+  mesh = build_mesh(N, "cpu")
+  vals = jnp.stack([jnp.arange(5, dtype=jnp.float32) + 10.0 * r
+                    for r in range(N)])
+
+  def body(v):
+    return allreduce.hier_reduce(jnp.squeeze(v, 0), "replica",
+                                 groups=groups)[None]
+
+  f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(P("replica"),),
+                            out_specs=P("replica")))
+  expect = np.asarray(vals).mean(0)
+  np.testing.assert_allclose(np.asarray(f(vals)),
+                             np.tile(expect, (N, 1)), rtol=1e-6)
+
+
+def test_hier_stale_group_length_falls_back_to_pmean():
+  """A reducer built for another mesh size (e.g. surviving an elastic
+  resize) must not mis-permute: wrong-length groups reduce flat."""
+  mesh = build_mesh(N, "cpu")
+  vals = jnp.stack([jnp.full((3,), float(r)) for r in range(N)])
+  for groups in ([0, 0, 1, 1], [0] * 12):  # built for n=4 / n=12, axis is 8
+    f = jax.jit(jax.shard_map(
+        lambda v: allreduce.hier_reduce(jnp.squeeze(v, 0), "replica",
+                                        groups=groups)[None],
+        mesh=mesh, in_specs=(P("replica"),), out_specs=P("replica")))
+    np.testing.assert_allclose(np.asarray(f(vals)), np.full((N, 3), 3.5),
+                               rtol=1e-6)
+
+
+def test_hier_unequal_groups_fall_back_to_pmean():
+  mesh = build_mesh(N, "cpu")
+  vals = jnp.stack([jnp.full((3,), float(r)) for r in range(N)])
+  groups = [0, 0, 0, 1, 1, 1, 1, 1]  # 3 vs 5: asymmetric topology
+
+  def body(v):
+    return allreduce.hier_reduce(jnp.squeeze(v, 0), "replica",
+                                 groups=groups)[None]
+
+  f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(P("replica"),),
+                            out_specs=P("replica")))
+  np.testing.assert_allclose(np.asarray(f(vals)), np.full((N, 3), 3.5),
+                             rtol=1e-6)
+
+
+@pytest.mark.distributed
+def test_two_process_hierarchical_copy_groups_and_numerics(tmp_path):
+  """2-process virtual cluster: build_reducer's hierarchical_copy groups
+  must align with process boundaries and the grouped reduction must
+  match pmean (VERDICT r2 #5). Each worker runs the assertion on the
+  GLOBAL 4-device mesh (2 per process) via jax.distributed."""
+  import subprocess
+  import sys
+  from tests.test_distributed_training import _free_port
+  port = _free_port()
+  prog = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp, numpy as np
+from jax.sharding import PartitionSpec as P
+jax.distributed.initialize(coordinator_address="127.0.0.1:%d",
+                           num_processes=2,
+                           process_id=int(sys.argv[1]))
+from kf_benchmarks_tpu import params as params_lib
+from kf_benchmarks_tpu.ops import allreduce
+from kf_benchmarks_tpu.parallel import mesh as mesh_lib
+
+devices = mesh_lib.get_devices("cpu", 2)
+groups = allreduce.topology_groups(devices, num_groups=jax.process_count())
+# Groups ARE the process boundaries.
+assert groups == [d.process_index for d in devices], (groups, devices)
+assert sorted(set(groups)) == [0, 1]
+
+p = params_lib.make_params(variable_update="replicated", device="cpu",
+                           num_devices=2, hierarchical_copy=True)
+reducer = allreduce.build_reducer(p)
+mesh = mesh_lib.build_mesh(2, "cpu")
+n = len(devices)
+local = np.stack([np.arange(6, dtype=np.float32) + 10.0 * d.id
+                  for d in devices if d.process_index == jax.process_index()])
+vals = jax.make_array_from_process_local_data(
+    jax.sharding.NamedSharding(mesh, P("replica")), local)
+f = jax.jit(jax.shard_map(
+    lambda v: reducer(jnp.squeeze(v, 0), "replica")[None], mesh=mesh,
+    in_specs=(P("replica"),), out_specs=P("replica")))
+out = np.asarray(jax.device_get(f(vals).addressable_shards[0].data))
+expect = np.mean([np.arange(6, dtype=np.float32) + 10.0 * d.id for d in devices],
+                 axis=0)
+np.testing.assert_allclose(out[0], expect, rtol=1e-6)
+print("HIER_OK", jax.process_index())
+""" % port
+  env = dict(os.environ)
+  env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+  env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+  procs = [subprocess.Popen([sys.executable, "-c", prog, str(i)], env=env,
+                            cwd=REPO, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+           for i in range(2)]
+  outs = [p.communicate(timeout=300) for p in procs]
+  for i, p in enumerate(procs):
+    assert p.returncode == 0, outs[i][1][-3000:]
+    assert f"HIER_OK {i}" in outs[i][0]
 
 
 def test_strategy_integration():

@@ -113,3 +113,21 @@ def test_drain_resolves_sharded_and_replicated_leaves():
   sync.drain({"x": 1.0})                # non-array leaves are skipped
   assert float(replicated) == 3.5
   assert float(jnp.sum(sharded)) == 28.0
+
+  # Mixed device footprints: a single-device scalar next to mesh-wide
+  # arrays must not stop the mesh-wide leaves from being drained (one
+  # smallest leaf is fetched PER distinct device set, utils/sync.py).
+  single = jax.device_put(jnp.float32(1.0), jax.devices("cpu")[0])
+  fetched = []
+  orig = jax.device_get
+  try:
+    jax.device_get = lambda x: fetched.append(x) or orig(x)
+    sync.drain({"s": single, "a": sharded, "b": replicated})
+  finally:
+    jax.device_get = orig
+  # Two distinct device sets -> two fetches: the 1-device scalar and the
+  # smallest 4-device leaf (the replicated scalar), not just the global
+  # smallest.
+  assert len(fetched) == 2
+  flat = [x for f in fetched for x in (f if isinstance(f, list) else [f])]
+  assert len(flat) == 1 + 4

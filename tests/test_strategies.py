@@ -267,6 +267,103 @@ def test_pair_average_preserves_network_mean():
     vals = new_vals
 
 
+@pytest.mark.parametrize("force_hops", [False, True])
+def test_pair_average_matches_direct_permutation_all_shifts(
+    monkeypatch, force_hops):
+  """Both gossip lowerings -- the small-n single-send switch and the
+  at-scale log2(n)-hop decomposition -- must be bit-identical to the
+  direct shift-s permutation for every step of the rotation: ppermute
+  moves data without arithmetic, so composing gated power-of-two hops
+  then averaging once is exact (VERDICT r2 #4)."""
+  from jax.sharding import PartitionSpec as P
+  if force_hops:
+    monkeypatch.setattr(kungfu, "GOSSIP_SWITCH_MAX_N", 1)
+  mesh = build_mesh(N_REPLICAS, "cpu")
+  n = N_REPLICAS
+  vals = (jnp.arange(n * 3, dtype=jnp.float32).reshape(n, 3) * 1.7 + 0.3)
+
+  f = jax.jit(jax.shard_map(
+      lambda v, s: kungfu.pair_average(v[0], s)[None], mesh=mesh,
+      in_specs=(P("replica"), P()), out_specs=P("replica")))
+  for step in range(2 * (n - 1)):
+    shift = 1 + step % (n - 1)
+    out = np.asarray(f(vals, jnp.int32(step)))
+    # Replica i receives from (i - shift) mod n == np.roll by +shift.
+    expect = 0.5 * (np.asarray(vals) + np.roll(np.asarray(vals), shift, 0))
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_pair_average_program_size_is_log_n_at_scale(monkeypatch):
+  """Above GOSSIP_SWITCH_MAX_N the HLO holds ceil(log2 n)
+  collective-permutes and no conditional branches -- program size stays
+  flat at pod scale (a switch would bake 255 branches at n=256); at or
+  below the threshold the switch lowering keeps the single-send-per-step
+  wire cost (VERDICT r2 #4)."""
+  import math
+  from jax.sharding import PartitionSpec as P
+  mesh = build_mesh(N_REPLICAS, "cpu")
+
+  def lower():
+    return jax.jit(jax.shard_map(
+        lambda v, s: kungfu.pair_average(v[0], s)[None], mesh=mesh,
+        in_specs=(P("replica"), P()), out_specs=P("replica"))).lower(
+            jax.ShapeDtypeStruct((N_REPLICAS, 4), jnp.float32),
+            jax.ShapeDtypeStruct((), jnp.int32)).as_text()
+
+  # Default at n=8 (<= threshold): switch lowering, n-1 branches.
+  txt = lower()
+  assert "case" in txt
+  assert txt.count("collective_permute") == N_REPLICAS - 1
+  # Forced at-scale lowering: log2(n) gated hops, no switch.
+  monkeypatch.setattr(kungfu, "GOSSIP_SWITCH_MAX_N", 1)
+  txt = lower()
+  n_perm = txt.count("collective_permute")
+  assert n_perm == math.ceil(math.log2(N_REPLICAS)), (n_perm, txt[:2000])
+  assert "case" not in txt  # no lax.switch residue
+
+
+@pytest.mark.distributed
+def test_pair_average_scales_to_16_devices():
+  """n=16: 4 collective-permutes (not 15 branches) and exact numerics,
+  verified in a subprocess with a 16-device virtual CPU mesh."""
+  import os
+  import subprocess
+  import sys
+  prog = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")  # sanctioned flip (CLAUDE.md)
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+from kf_benchmarks_tpu.parallel import kungfu
+from kf_benchmarks_tpu.parallel.mesh import build_mesh
+n = 16
+mesh = build_mesh(n, "cpu")
+vals = jnp.arange(n * 2, dtype=jnp.float32).reshape(n, 2)
+f = jax.jit(jax.shard_map(
+    lambda v, s: kungfu.pair_average(v[0], s)[None], mesh=mesh,
+    in_specs=(P("replica"), P()), out_specs=P("replica")))
+lowered = f.lower(jax.ShapeDtypeStruct((n, 2), jnp.float32),
+                  jax.ShapeDtypeStruct((), jnp.int32))
+assert lowered.as_text().count("collective_permute") == 4
+for step in (0, 6, 14):
+  shift = 1 + step % (n - 1)
+  out = np.asarray(f(vals, jnp.int32(step)))
+  np.testing.assert_array_equal(
+      out, 0.5 * (np.asarray(vals) + np.roll(np.asarray(vals), shift, 0)))
+print("OK16")
+"""
+  import os
+  repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+  env = dict(os.environ)
+  env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+  env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+  r = subprocess.run([sys.executable, "-c", prog], capture_output=True,
+                     text=True, timeout=300, env=env, cwd=repo)
+  assert r.returncode == 0, r.stderr[-2000:]
+  assert "OK16" in r.stdout
+
+
 def test_broadcast_init_syncs_to_replica0():
   mesh = build_mesh(N_REPLICAS, "cpu")
   from jax.sharding import PartitionSpec as P
