@@ -1,0 +1,126 @@
+"""Host-side input-pipeline throughput measurement (VERDICT r3 item #2).
+
+Measures the REAL-DATA feed rate (TFRecord -> decode -> crop/resize ->
+normalized numpy batch) with NO device in the loop: the feed rate is a
+host property, and the question is whether the host can hold the
+~2,600 img/s the TPU consumes (PERF.md). Run from the repo root:
+
+    python experiments/input_pipeline_bench.py [--images 512]
+    [--size 375x500] [--batch 256] [--mode thread|process|both]
+
+Writes realistic JPEGs (smoothed random content -- solid-color squares
+decode unrealistically fast, white noise unrealistically slow) sized
+like typical ImageNet photos, then times minibatch production.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kf_benchmarks_tpu.data import example as example_lib  # noqa: E402
+from kf_benchmarks_tpu.data import tfrecord  # noqa: E402
+
+
+def realistic_jpeg(rng: np.random.RandomState, h: int, w: int,
+                   quality: int = 85) -> bytes:
+  """JPEG with photo-like spectral content: coarse random blocks smoothed
+  by bilinear upscaling, plus mild noise."""
+  from PIL import Image
+  coarse = rng.randint(0, 256, size=(h // 16 + 1, w // 16 + 1, 3)
+                       ).astype(np.uint8)
+  img = Image.fromarray(coarse).resize((w, h), Image.BILINEAR)
+  arr = np.asarray(img, np.int16)
+  arr = np.clip(arr + rng.randint(-12, 13, arr.shape), 0, 255
+                ).astype(np.uint8)
+  buf = io.BytesIO()
+  Image.fromarray(arr).save(buf, format="JPEG", quality=quality)
+  return buf.getvalue()
+
+
+def write_fixture(data_dir: str, n: int, h: int, w: int,
+                  shards: int = 4) -> None:
+  rng = np.random.RandomState(0)
+  per = -(-n // shards)
+  for s in range(shards):
+    with tfrecord.TFRecordWriter(
+        tfrecord.shard_path(data_dir, "train", s, shards)) as wtr:
+      for _ in range(min(per, n - s * per)):
+        wtr.write(example_lib.encode_example({
+            "image/encoded": realistic_jpeg(rng, h, w),
+            "image/class/label": np.array([rng.randint(1, 1001)], np.int64),
+            "image/object/bbox/xmin": np.array([0.1], np.float32),
+            "image/object/bbox/ymin": np.array([0.1], np.float32),
+            "image/object/bbox/xmax": np.array([0.9], np.float32),
+            "image/object/bbox/ymax": np.array([0.9], np.float32),
+        }))
+
+
+class _Dataset:
+  def __init__(self, data_dir):
+    self.data_dir = data_dir
+
+
+def measure(pre, data_dir: str, batch: int, warm_batches: int = 2,
+            timed_batches: int = 8) -> float:
+  it = pre.minibatches(_Dataset(data_dir), "train")
+  for _ in range(warm_batches):
+    next(it)
+  t0 = time.time()
+  for _ in range(timed_batches):
+    images, labels = next(it)
+  dt = time.time() - t0
+  assert images.shape[0] == batch
+  return timed_batches * batch / dt
+
+
+def main():
+  ap = argparse.ArgumentParser(description=__doc__)
+  ap.add_argument("--images", type=int, default=512)
+  ap.add_argument("--size", default="375x500")  # HxW, typical ImageNet
+  ap.add_argument("--batch", type=int, default=256)
+  ap.add_argument("--distortions", action="store_true")
+  ap.add_argument("--mode", default="both",
+                  choices=("thread", "process", "both"))
+  ap.add_argument("--workers", type=int, default=0,
+                  help="0 = auto (cpu count)")
+  args = ap.parse_args()
+  h, w = (int(x) for x in args.size.split("x"))
+
+  from kf_benchmarks_tpu.data import preprocessing
+
+  with tempfile.TemporaryDirectory() as d:
+    t0 = time.time()
+    write_fixture(d, args.images, h, w)
+    print(f"fixture: {args.images} {h}x{w} JPEGs in {time.time()-t0:.1f}s "
+          f"on {os.cpu_count()} CPU core(s)", flush=True)
+    results = {}
+    if args.mode in ("thread", "both"):
+      pre = preprocessing.RecordInputImagePreprocessor(
+          args.batch, (224, 224, 3), train=True,
+          distortions=args.distortions,
+          num_threads=args.workers or os.cpu_count() or 8)
+      results["thread_pool"] = measure(pre, d, args.batch)
+      print(f"thread_pool: {results['thread_pool']:.1f} images/sec",
+            flush=True)
+    if args.mode in ("process", "both"):
+      pre = preprocessing.MultiprocessImagePreprocessor(
+          args.batch, (224, 224, 3), train=True,
+          distortions=args.distortions,
+          num_processes=args.workers or None)
+      results["process_pool"] = measure(pre, d, args.batch)
+      print(f"process_pool: {results['process_pool']:.1f} images/sec",
+            flush=True)
+  return results
+
+
+if __name__ == "__main__":
+  main()

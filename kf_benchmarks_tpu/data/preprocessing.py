@@ -161,17 +161,40 @@ def distort_color(img: "Image.Image", batch_position: int,
   return img
 
 
+def _draft_decode(img: "Image.Image", need_w: int, need_h: int):
+  """DCT-domain reduced-scale JPEG decode (PIL ``draft``): ask libjpeg to
+  decode at 1/2, 1/4, or 1/8 scale when the consumer only needs
+  ``need_w x need_h`` of the full frame. This is the single biggest
+  host-decode win on photo-sized inputs and the PIL analog of the
+  reference's fused decode-and-crop JPEG path (ref:
+  preprocessing.py:192-265 fuse_decode_and_crop). Returns the
+  (possibly scaled) image; a no-op for non-JPEG content. Callers must
+  rescale any full-frame pixel coordinates by the returned image's
+  size ratio."""
+  img.draft("RGB", (max(1, int(need_w)), max(1, int(need_h))))
+  return img
+
+
 def train_image(image_buffer: bytes, height: int, width: int,
                 bbox: np.ndarray, batch_position: int,
                 resize_method: str, distortions: bool,
                 rng: random.Random) -> np.ndarray:
   """Distorted-crop training path -> float32 [0,255] HWC
   (ref: train_image, preprocessing.py:192-265)."""
-  img = Image.open(io.BytesIO(image_buffer)).convert("RGB")
+  img = Image.open(io.BytesIO(image_buffer))
   iw, ih = img.size
+  # The crop is sampled in FULL-frame coordinates (the rng stream is
+  # independent of the decode scale), then the decode runs at the
+  # smallest DCT scale that still covers the target resolution inside
+  # the crop, and the coordinates are mapped onto the decoded frame.
   y, x, h, w = sample_distorted_bounding_box(rng, ih, iw, bbox)
+  _draft_decode(img, iw * width / max(w, 1), ih * height / max(h, 1))
+  img = img.convert("RGB")
+  sx, sy = img.size[0] / iw, img.size[1] / ih
   # fuse_decode_and_crop analog: crop before the (expensive) resize.
-  img = img.crop((x, y, x + w, y + h))
+  img = img.crop((int(x * sx), int(y * sy),
+                  max(int(x * sx) + 1, int((x + w) * sx)),
+                  max(int(y * sy) + 1, int((y + h) * sy))))
   method = get_image_resize_method(resize_method, batch_position)
   img = img.resize((width, height), method)
   if rng.random() < 0.5:
@@ -185,7 +208,10 @@ def eval_image(image_buffer: bytes, height: int, width: int,
                batch_position: int, resize_method: str) -> np.ndarray:
   """Central-crop-87.5% eval path -> float32 [0,255] HWC
   (ref: eval_image, preprocessing.py:137-190)."""
-  img = Image.open(io.BytesIO(image_buffer)).convert("RGB")
+  img = Image.open(io.BytesIO(image_buffer))
+  # 87.5% central crop resized to HxW only needs ~H/0.875 of the frame.
+  _draft_decode(img, width / 0.875, height / 0.875)
+  img = img.convert("RGB")
   iw, ih = img.size
   ch, cw = int(ih * 0.875), int(iw * 0.875)
   y, x = (ih - ch) // 2, (iw - cw) // 2
@@ -290,22 +316,30 @@ class RecordInputImagePreprocessor(InputPreprocessor):
     if not _HAVE_PIL:  # pragma: no cover
       raise NotImplementedError("PIL is required for the real-data pipeline")
     stream = self._record_stream(dataset, subset)
-    pool = concurrent.futures.ThreadPoolExecutor(self.num_threads)
     rngs = [random.Random(self.seed + 7919 * i)
             for i in range(self.batch_size)]
+    # Serial fast path: a 1-worker executor adds only GIL hand-off
+    # overhead (experiments/input_pipeline_bench.py).
+    pool = (concurrent.futures.ThreadPoolExecutor(self.num_threads)
+            if self.num_threads > 1 else None)
     try:
       while True:
         records = list(itertools.islice(stream, self.batch_size))
         if len(records) < self.batch_size:
           return  # eval stream exhausted (train replays forever)
-        futs = [pool.submit(self._preprocess_one, rec, i, rngs[i])
-                for i, rec in enumerate(records)]
-        results = [f.result() for f in futs]
+        if pool is None:
+          results = [self._preprocess_one(rec, i, rngs[i])
+                     for i, rec in enumerate(records)]
+        else:
+          futs = [pool.submit(self._preprocess_one, rec, i, rngs[i])
+                  for i, rec in enumerate(records)]
+          results = [f.result() for f in futs]
         images = np.stack([r[0] for r in results])
         labels = np.asarray([r[1] for r in results], np.int32)
         yield images, labels
     finally:
-      pool.shutdown(wait=False)
+      if pool is not None:
+        pool.shutdown(wait=False)
 
 
 class OfficialImagenetPreprocessor(RecordInputImagePreprocessor):
@@ -343,6 +377,147 @@ class OfficialImagenetPreprocessor(RecordInputImagePreprocessor):
       img = img.crop((x, y, x + self.width, y + self.height))
       arr = np.asarray(img, np.float32)
     return arr - self.CHANNEL_MEANS, label
+
+
+def _mp_decode_worker(task_q, done_q, shm_name, buf_shape, pre_bytes):
+  """Decode worker for MultiprocessImagePreprocessor. Runs in a SPAWNED
+  process (no inherited device/tunnel file descriptors, no jax import):
+  pulls (buffer, position, batch_index, record) tasks, decodes with the
+  pickled preprocessor's single-image path, and writes the image
+  directly into its final batch position in the shared-memory ring."""
+  from multiprocessing import shared_memory  # noqa: PLC0415
+  pre = pickle.loads(pre_bytes)
+  shm = shared_memory.SharedMemory(name=shm_name)
+  ring = np.ndarray(buf_shape, np.float32, buffer=shm.buf)
+  try:
+    while True:
+      task = task_q.get()
+      if task is None:
+        return
+      buf, pos, batch_idx, record = task
+      # Deterministic per-(position, batch) stream: workers hold no
+      # cross-batch rng state, so the stream is derived, not advanced.
+      rng = random.Random(pre.seed + 7919 * pos + 104729 * batch_idx)
+      try:
+        img, label = pre._preprocess_one(record, pos, rng)
+        ring[buf, pos] = img
+        done_q.put((buf, pos, int(label), None))
+      except Exception as e:  # surface decode errors to the parent
+        done_q.put((buf, pos, -1, repr(e)))
+  finally:
+    shm.close()
+
+
+class MultiprocessImagePreprocessor(RecordInputImagePreprocessor):
+  """Process-parallel TFRecord image pipeline: the RecordInput /
+  tf.data-C++-threadpool analog for multi-core hosts (ref:
+  preprocessing.py:505-548 parallel interleave/map, :601-617
+  RecordInput; VERDICT r2 #2).
+
+  The Python thread pool above cannot scale JPEG decode past ~1 core
+  (GIL); this variant spawns decode worker PROCESSES that write images
+  straight into their final batch slot in a shared-memory ring of
+  ``num_buffers`` global batches -- one memcpy per batch at yield, no
+  pickling of decoded tensors. Batches are dispatched one ahead so
+  workers decode batch k+1 while the consumer holds batch k. Workers
+  are spawned (not forked): the parent holds live device-tunnel file
+  descriptors a fork would duplicate.
+
+  Select with --input_preprocessor=multiprocess. ``num_threads`` is
+  interpreted as the worker-process count.
+  """
+
+  def __init__(self, *args, num_processes: Optional[int] = None,
+               num_buffers: int = 3, **kwargs):
+    super().__init__(*args, **kwargs)
+    self.num_processes = max(1, num_processes or self.num_threads or
+                             os.cpu_count() or 1)
+    self.num_buffers = max(2, num_buffers)
+
+  def minibatches(self, dataset, subset: str):
+    if not _HAVE_PIL:  # pragma: no cover
+      raise NotImplementedError("PIL is required for the real-data pipeline")
+    import multiprocessing  # noqa: PLC0415
+    from multiprocessing import shared_memory  # noqa: PLC0415
+    ctx = multiprocessing.get_context("spawn")
+    stream = self._record_stream(dataset, subset)
+    shape = (self.num_buffers, self.batch_size, self.height, self.width,
+             self.depth)
+    nbytes = int(np.prod(shape)) * 4
+    shm = shared_memory.SharedMemory(create=True, size=nbytes)
+    ring = np.ndarray(shape, np.float32, buffer=shm.buf)
+    task_q = ctx.Queue()
+    done_q = ctx.Queue()
+    pre_bytes = pickle.dumps(self)
+    workers = [
+        ctx.Process(target=_mp_decode_worker,
+                    args=(task_q, done_q, shm.name, shape, pre_bytes),
+                    daemon=True)
+        for _ in range(self.num_processes)]
+    for w in workers:
+      w.start()
+    # Per-buffer bookkeeping for the one-batch-ahead pipeline.
+    remaining = [0] * self.num_buffers
+    labels_buf = [np.empty(self.batch_size, np.int32)
+                  for _ in range(self.num_buffers)]
+
+    def dispatch(batch_idx: int) -> bool:
+      records = list(itertools.islice(stream, self.batch_size))
+      if len(records) < self.batch_size:
+        return False
+      buf = batch_idx % self.num_buffers
+      remaining[buf] = self.batch_size
+      for pos, rec in enumerate(records):
+        task_q.put((buf, pos, batch_idx, rec))
+      return True
+
+    def collect(buf: int):
+      import queue as queue_lib  # noqa: PLC0415
+      while remaining[buf] > 0:
+        try:
+          b, pos, label, err = done_q.get(timeout=0.5)
+        except queue_lib.Empty:
+          # A worker killed hard (OOM/segfault in libjpeg) never posts
+          # its completion; poll liveness so the trainer fails loudly
+          # instead of hanging (same pattern as DeviceFeeder.__next__).
+          dead = [w for w in workers if not w.is_alive()]
+          if dead:
+            raise RuntimeError(
+                f"{len(dead)} decode worker(s) died (exitcodes "
+                f"{[w.exitcode for w in dead]}) with "
+                f"{remaining[buf]} images outstanding")
+          continue
+        if err is not None:
+          raise RuntimeError(f"decode worker failed at buffer {b} "
+                             f"position {pos}: {err}")
+        labels_buf[b][pos] = label
+        remaining[b] -= 1
+
+    try:
+      if not dispatch(0):
+        return
+      batch_idx = 0
+      while True:
+        has_next = dispatch(batch_idx + 1)
+        buf = batch_idx % self.num_buffers
+        collect(buf)
+        # Copy-out keeps the slot reusable regardless of how long the
+        # consumer holds the batch (device_put may be asynchronous).
+        yield ring[buf].copy(), labels_buf[buf].copy()
+        if not has_next:
+          return
+        batch_idx += 1
+    finally:
+      for _ in workers:
+        task_q.put(None)
+      for w in workers:
+        w.join(timeout=5)
+        if w.is_alive():  # pragma: no cover
+          w.terminate()
+      task_q.close()
+      done_q.close()
+      shm.close()
+      shm.unlink()
 
 
 class Cifar10ImagePreprocessor(InputPreprocessor):
@@ -727,9 +902,17 @@ def get_preprocessor(dataset_name: str, kind: str = "default"):
       raise ValueError("official_models_imagenet preprocessing applies "
                        f"to the imagenet dataset, not {dataset_name!r}")
     return OfficialImagenetPreprocessor
+  if kind == "multiprocess":
+    # Process-parallel decode (the RecordInput/tf.data C++-threadpool
+    # throughput analog) for multi-core hosts.
+    if dataset_name != "imagenet":
+      raise ValueError("multiprocess preprocessing applies to the "
+                       f"imagenet dataset, not {dataset_name!r}")
+    return MultiprocessImagePreprocessor
   if kind != "default":
     raise ValueError(f"Unknown input preprocessor {kind!r}; expected "
-                     f"'default', 'official_models_imagenet', or 'test'")
+                     "'default', 'official_models_imagenet', "
+                     "'multiprocess', or 'test'")
   if dataset_name not in _PREPROCESSORS:
     raise NotImplementedError(
         f"No input preprocessor for dataset {dataset_name!r}")
