@@ -49,10 +49,29 @@ def is_chief() -> bool:
   return jax.process_index() == 0
 
 
+def _replica0_local(x):
+  """Replica-0 slice read from LOCAL shards only.
+
+  ``np.asarray(x[0])`` on a multi-process sharded array dispatches a
+  global slice computation that every process must join -- on the chief
+  alone it deadlocks (observed: restart-resize checkpoint hung the
+  2-process test). Replica 0 is addressable on the chief, so read the
+  shard whose index range covers row 0 directly."""
+  shards = getattr(x, "addressable_shards", None)
+  if shards and getattr(x, "ndim", 0) >= 1:
+    for s in shards:
+      idx = s.index
+      sl = idx[0] if idx else slice(None)
+      start = sl.start or 0
+      if start == 0:
+        return np.asarray(jax.device_get(s.data))[0]
+  return np.asarray(x[0])
+
+
 def savable_state(state) -> dict:
   """Host-side, mode-invariant snapshot: replica-0 slice of the stacked
   arrays + replicated scalars (ref: variable_mgr savable_variables)."""
-  slice0 = lambda t: jax.tree.map(lambda x: np.asarray(x[0]), t)
+  slice0 = lambda t: jax.tree.map(_replica0_local, t)
   return {
       "step": int(state.step),
       "params": slice0(state.params),

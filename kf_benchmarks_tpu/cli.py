@@ -27,7 +27,14 @@ def main(positional_arguments):
   params = params_lib.make_params_from_flags()
   params = benchmark.setup(params)
   bench = benchmark.BenchmarkCNN(params)
-  bench.run()
+  stats = bench.run()
+
+  # Cross-process elastic resize: the run checkpointed and barriered;
+  # exit with the launcher's restart code so kfrun re-execs this worker
+  # set at the new world size (SURVEY 5.3/7.4 checkpointed rescale).
+  if isinstance(stats, dict) and stats.get("restart_for_resize"):
+    from kf_benchmarks_tpu import kfrun
+    sys.exit(kfrun.RESTART_EXIT_CODE)
 
   # KungFu exit barrier (ref: tf_cnn_benchmarks.py:58-60).
   if params.variable_update == "kungfu":

@@ -29,6 +29,16 @@ class BaseClusterManager:
   def __init__(self, params):
     worker_hosts = list(params.worker_hosts or [])
     ps_hosts = list(params.ps_hosts or [])
+    # Under the kfrun launcher the LIVE world size is KFCOORD_WORLD (a
+    # checkpoint-restart resize relaunches the same command with a new
+    # world), so the static --worker_hosts list is truncated to the
+    # generation's actual size; hosts beyond the provisioned list
+    # cannot be invented, so the world is capped at the list length.
+    import os
+    env_world = os.environ.get("KFCOORD_WORLD")
+    if env_world and worker_hosts:
+      worker_hosts = worker_hosts[:max(1, min(int(env_world),
+                                              len(worker_hosts)))]
     if params.job_name in ("ps", "controller"):
       raise ValueError(
           f"job_name={params.job_name!r} has no TPU analog: parameter "

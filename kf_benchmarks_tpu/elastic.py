@@ -137,6 +137,16 @@ class ElasticController:
     self._client = client
     self._max_devices = max_devices
     self._last_target: Optional[int] = None
+    # The UNCLAMPED size of the last resize poll() surfaced: the
+    # benchmark uses it to decide whether the target fits this process
+    # set (in-mesh reshape) or needs a checkpoint-restart with a new
+    # process count (kfrun restart leg, SURVEY 7.4).
+    self.last_raw_target: Optional[int] = None
+
+  @property
+  def max_devices(self) -> int:
+    """Per-process device capacity (locally visible devices)."""
+    return self._max_devices
 
   @classmethod
   def from_env(cls, max_devices: int) -> Optional["ElasticController"]:
@@ -163,7 +173,48 @@ class ElasticController:
     if target is None or target == self._last_target:
       return None
     self._last_target = target
+    self.last_raw_target = target
     return max(1, min(target, self._max_devices))
+
+  def restart_barrier(self, name: str, count: int) -> None:
+    """Rendezvous before a checkpoint-restart resize: guarantees the
+    chief's snapshot is on disk (the chief enters after writing) before
+    any worker exits for re-exec."""
+    self._client.barrier(name, count)
+
+  def generation(self) -> int:
+    return self._client.current_generation()
+
+  # -- scheduled-restart agreement ------------------------------------------
+  #
+  # Workers poll the coordinator at the same STEP cadence but at
+  # different WALL times, so a RESIZE can land between two workers'
+  # polls of the same step -- an immediate restart would split-brain
+  # (observed: one worker restarted, its sibling ran to completion).
+  # Agreement: the first worker to see the target SCHEDULES the restart
+  # at a future step in the coordinator's kv store; every worker adopts
+  # the schedule at its own polls, so all restart at the same step (the
+  # config-server-synchronized resize point of KungFu's runtime).
+
+  def scheduled_restart(self):
+    """(step, target_np) of the pending scheduled restart, else None."""
+    try:
+      gen = self._client.current_generation()
+      val = self._client.kv_tryget(f"kf_restart_sched_{gen}")
+    except Exception:
+      return None
+    if not val:
+      return None
+    step_s, _, np_s = val.decode().partition(":")
+    return int(step_s), int(np_s)
+
+  def schedule_restart(self, step: int, target_np: int) -> None:
+    try:
+      gen = self._client.current_generation()
+      self._client.kv_put(f"kf_restart_sched_{gen}",
+                          f"{step}:{target_np}".encode())
+    except Exception:
+      pass  # a sibling's schedule (or a later poll) will carry it
 
   def close(self) -> None:
     close = getattr(self._client, "close", None)

@@ -13,6 +13,15 @@ captures per-process logs with the same naming scheme. Workers find
 their rank by JOINing the coordinator; `run_barrier()` rides the same
 service at exit.
 
+Cross-process elastic resize (the KungFu resize_cluster restart leg,
+SURVEY 5.3/7.4 "checkpointed rescale"): a live JAX world cannot change
+its process count, so when a kfcoord RESIZE requires one, every worker
+checkpoints, enters a restart barrier, and exits with
+``RESTART_EXIT_CODE``. kfrun treats that exit as a coordinated restart
+request: it reads the target size from its coordinator and relaunches
+the SAME command with the new world size (logs append across
+generations). Workers resume from the checkpoint in ``--train_dir``.
+
 Usage:
     python -m kf_benchmarks_tpu.kfrun -np 4 -- python -m \
         kf_benchmarks_tpu.cli --model=resnet50 --variable_update=kungfu
@@ -30,16 +39,19 @@ import os
 import signal
 import subprocess
 import sys
-from typing import List, Optional
+from typing import List, Optional, Tuple
+
+# Exit code a worker uses to request a coordinated checkpoint-restart
+# resize (chosen outside the shell/POSIX reserved ranges).
+RESTART_EXIT_CODE = 42
 
 
-def launch(np_: int, command: List[str], logdir: str = ".",
-           host: str = "127.0.0.1", base_port: int = 0,
-           extra_env: Optional[dict] = None) -> int:
-  """Start coordinator + N workers; wait; return worst exit code."""
-  from kf_benchmarks_tpu.parallel import coordination
+def _run_generation(server, np_: int, command: List[str], logdir: str,
+                    host: str, extra_env: Optional[dict]) -> Tuple[int, bool]:
+  """Spawn one generation of ``np_`` workers; wait.
 
-  server = coordination.CoordinatorServer(port=base_port)
+  Returns (exit_code, restart_requested). Logs append so a restarted
+  generation's output lands in the same per-worker files."""
   procs = []
   log_files = []
   try:
@@ -51,23 +63,25 @@ def launch(np_: int, command: List[str], logdir: str = ".",
       env["KFCOORD_WORLD"] = str(np_)
       env["KFCOORD_NAME"] = f"worker-{i}"
       env["KFCOORD_RANK_HINT"] = str(i)
-      # Per-process log capture, named the way kungfu-run names them.
+      # Per-process log capture, named the way kungfu-run names them
+      # (append: restart generations continue the same files).
       tag = f"{host}.{10000 + i}"
-      out = open(os.path.join(logdir, f"{tag}.stdout.log"), "w")
-      err = open(os.path.join(logdir, f"{tag}.stderr.log"), "w")
+      out = open(os.path.join(logdir, f"{tag}.stdout.log"), "a")
+      err = open(os.path.join(logdir, f"{tag}.stderr.log"), "a")
       log_files += [out, err]
       procs.append(subprocess.Popen(command, env=env, stdout=out,
                                     stderr=err))
     # Monitor rather than blindly wait: if one worker dies abnormally
     # while its siblings are parked in the exit barrier, the barrier can
     # never fill -- tear the job down instead of hanging (the
-    # kungfu-run failure contract).
+    # kungfu-run failure contract). RESTART_EXIT_CODE is a coordinated
+    # exit, not a failure.
     import time
     while True:
       codes = [p.poll() for p in procs]
       if all(c is not None for c in codes):
         break
-      if any(c not in (None, 0) for c in codes):
+      if any(c not in (None, 0, RESTART_EXIT_CODE) for c in codes):
         time.sleep(1.0)  # grace: let siblings exit on their own
         for p in procs:
           if p.poll() is None:
@@ -81,21 +95,70 @@ def launch(np_: int, command: List[str], logdir: str = ".",
         codes = [p.poll() for p in procs]
         break
       time.sleep(0.1)
+    if (any(c == RESTART_EXIT_CODE for c in codes) and
+        all(c in (0, RESTART_EXIT_CODE) for c in codes)):
+      return 0, True
     # Report the original failure, not the SIGTERM we delivered: a worker
     # killed by our teardown shows -15, which would mask the real code.
-    failures = [c for c in codes if c not in (0, -signal.SIGTERM)]
+    failures = [c for c in codes
+                if c not in (0, RESTART_EXIT_CODE, -signal.SIGTERM)]
     if failures:
-      return max(abs(c) for c in failures)
-    return 1 if any(c == -signal.SIGTERM for c in codes) else 0
+      return max(abs(c) for c in failures), False
+    return (1 if any(c == -signal.SIGTERM for c in codes) else 0), False
   except KeyboardInterrupt:
     for p in procs:
       p.send_signal(signal.SIGTERM)
     for p in procs:
       p.wait()
-    return 130
+    return 130, False
   finally:
     for f in log_files:
       f.close()
+
+
+def launch(np_: int, command: List[str], logdir: str = ".",
+           host: str = "127.0.0.1", base_port: int = 0,
+           extra_env: Optional[dict] = None,
+           max_restarts: int = 16) -> int:
+  """Start coordinator + N workers; relaunch on coordinated restarts;
+  return the final generation's worst exit code."""
+  from kf_benchmarks_tpu.parallel import coordination
+
+  server = coordination.CoordinatorServer(port=base_port)
+  try:
+    gen_np = np_
+    for _ in range(max_restarts + 1):
+      code, restart = _run_generation(server, gen_np, command, logdir,
+                                      host, extra_env)
+      if not restart:
+        return code
+      # The workers checkpointed and exited for a resize; relaunch at
+      # the PROCESS count they agreed on in the scheduled-restart key
+      # (the raw RESIZE target is a global DEVICE count -- with >1
+      # device per process the two differ, and respawning at the device
+      # count would churn restarts forever).
+      with coordination.CoordinatorClient(host=host,
+                                          port=server.port) as client:
+        new_np = gen_np
+        try:
+          gen = client.current_generation()
+          sched = client.kv_tryget(f"kf_restart_sched_{gen}")
+          if sched:
+            new_np = max(1, int(sched.decode().partition(":")[2]))
+          else:
+            target = client.try_target_size()
+            if target:
+              new_np = max(1, int(target))
+        except Exception as e:  # noqa: BLE001
+          print(f"kfrun: could not read restart target ({e}); "
+                f"respawning at np={gen_np}", file=sys.stderr, flush=True)
+      print(f"kfrun: coordinated restart, np {gen_np} -> {new_np}",
+            file=sys.stderr, flush=True)
+      gen_np = new_np
+    print(f"kfrun: giving up after {max_restarts} restarts",
+          file=sys.stderr, flush=True)
+    return 1
+  finally:
     server.stop()
 
 

@@ -81,6 +81,13 @@ def _load_library() -> ctypes.CDLL:
     return _lib
 
 
+def _decode_kv_token(token: str) -> bytes:
+  """Inverse of kv_put's wire encoding ('x' + hex for binary payloads;
+  raw tokens like RESIZE's decimal target pass through)."""
+  return bytes.fromhex(token[1:]) if token.startswith("x") else \
+      token.encode()
+
+
 class CoordinatorServer:
   """In-process coordinator (the config-server role of kungfu-run)."""
 
@@ -172,9 +179,7 @@ class CoordinatorClient:
 
   def kv_get(self, key: str, max_len: int = 1 << 20) -> bytes:
     """Blocking fetch (bootstrap exchange: workers GET what rank 0 PUT)."""
-    token = self._kv_get_raw(key, max_len)
-    return bytes.fromhex(token[1:]) if token.startswith("x") else \
-        token.encode()
+    return _decode_kv_token(self._kv_get_raw(key, max_len))
 
   def _kv_tryget_raw(self, key: str,
                      max_len: int = 1 << 20) -> Optional[str]:
@@ -189,6 +194,11 @@ class CoordinatorClient:
     if n < 0:
       raise RuntimeError(f"TRYGET {key} failed")
     return buf.value.decode()
+
+  def kv_tryget(self, key: str, max_len: int = 1 << 20) -> Optional[bytes]:
+    """Non-blocking kv_get; None when the key is absent."""
+    token = self._kv_tryget_raw(key, max_len)
+    return None if token is None else _decode_kv_token(token)
 
   def resize(self, new_size: int) -> int:
     """Request an elastic resize; returns the new generation

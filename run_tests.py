@@ -25,6 +25,7 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 DISTRIBUTED_TESTS = [
     "tests/test_distributed_training.py",
     "tests/test_elastic_process.py",
+    "tests/test_elastic_restart.py",
     "tests/test_kfrun.py",
 ]
 
