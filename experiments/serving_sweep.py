@@ -1,0 +1,79 @@
+"""Serving-path measurement: forward-only and AOT throughput/latency
+in NHWC on the real chip (VERDICT r3 item #3).
+
+Runs the CLI in subprocesses (stock axon environment; SERIALIZED -- one
+TPU client at a time) across a batch-size sweep, in two modes:
+
+  forward  -- the jitted eval program (--forward_only)
+  aot      -- export once with --aot_save_path, then benchmark the
+              frozen program in a FRESH process via --aot_load_path
+              (the TRT-analog serving benchmark)
+
+Prints a markdown table (img/s and ms/batch per bs) for PERF.md.
+
+    python experiments/serving_sweep.py [--batches 50] [--bs 32 64 128 256]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOTAL_RE = re.compile(r"^total images/sec: ([\d.]+)$", re.M)
+
+
+def run_cli(args, timeout=2400):
+  env = dict(os.environ)
+  env.pop("XLA_FLAGS", None)
+  env.pop("JAX_PLATFORMS", None)
+  r = subprocess.run([sys.executable, "-m", "kf_benchmarks_tpu.cli"] + args,
+                     capture_output=True, text=True, timeout=timeout,
+                     cwd=REPO, env=env)
+  if r.returncode != 0:
+    raise RuntimeError(f"{args}: {r.stdout[-2000:]} {r.stderr[-2000:]}")
+  m = TOTAL_RE.search(r.stdout)
+  if not m:
+    raise RuntimeError(f"no total line: {r.stdout[-2000:]}")
+  return float(m.group(1))
+
+
+def main():
+  ap = argparse.ArgumentParser(description=__doc__)
+  ap.add_argument("--model", default="resnet50")
+  ap.add_argument("--batches", type=int, default=50)
+  ap.add_argument("--warmup", type=int, default=10)
+  ap.add_argument("--bs", type=int, nargs="+", default=[32, 64, 128, 256])
+  ap.add_argument("--device", default="tpu")
+  args = ap.parse_args()
+
+  base = [f"--model={args.model}", f"--device={args.device}",
+          "--num_devices=1", f"--num_batches={args.batches}",
+          f"--num_warmup_batches={args.warmup}", "--use_fp16=true",
+          "--display_every=10"]
+  rows = []
+  for bs in args.bs:
+    fwd = run_cli(base + [f"--batch_size={bs}", "--forward_only"])
+    with tempfile.TemporaryDirectory() as td:
+      blob = os.path.join(td, "model.bin")
+      run_cli(base + [f"--batch_size={bs}", "--forward_only",
+                      f"--aot_save_path={blob}", "--num_batches=5"])
+      aot = run_cli(base + [f"--batch_size={bs}", "--forward_only",
+                            f"--aot_load_path={blob}"])
+    rows.append((bs, fwd, 1e3 * bs / fwd, aot, 1e3 * bs / aot))
+    print(f"bs={bs}: forward {fwd:.0f} img/s ({rows[-1][2]:.2f} ms/batch), "
+          f"aot {aot:.0f} img/s ({rows[-1][4]:.2f} ms/batch)", flush=True)
+
+  print("\n| bs | forward img/s | forward ms/batch | aot img/s | "
+        "aot ms/batch |")
+  print("|---|---|---|---|---|")
+  for bs, f_ips, f_ms, a_ips, a_ms in rows:
+    print(f"| {bs} | {f_ips:.0f} | {f_ms:.2f} | {a_ips:.0f} | {a_ms:.2f} |")
+
+
+if __name__ == "__main__":
+  main()

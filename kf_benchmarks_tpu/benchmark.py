@@ -912,27 +912,25 @@ class BenchmarkCNN:
               # would split-brain (SURVEY 5.3/7.4 "checkpointed
               # rescale"; KungFu resize_cluster's config-server-
               # synchronized resize).
-              capacity = max(1, jax.local_device_count())
-              procs = max(self.num_workers, 1)
-              required = max(1, -(-raw // capacity))
-              # The restart can only spawn processes that have somewhere
-              # to live: cap at the provisioned host list (absent a
-              # host list there is no distributed world to re-form, so
-              # the process count is pinned at 1 and scaling stays
-              # in-mesh).
-              max_procs = len(p.worker_hosts or []) or 1
-              required = min(required, max_procs)
-              if required != procs:
+              action, value = elastic_lib.plan_resize(
+                  raw, procs=max(self.num_workers, 1),
+                  capacity=jax.local_device_count(),
+                  # The restart can only spawn processes that have
+                  # somewhere to live: cap at the provisioned host list
+                  # (absent one there is no distributed world to
+                  # re-form, so scaling stays in-mesh).
+                  max_procs=len(p.worker_hosts or []) or 1)
+              if action == "restart":
                 if (hasattr(controller, "scheduled_restart") and
                     controller.scheduled_restart() is None):
                   k = max(1, p.elastic_check_every_n_steps)
-                  controller.schedule_restart((i + 1) + 2 * k, required)
+                  controller.schedule_restart((i + 1) + 2 * k, value)
                 # The restart owns this resize: the clamped global poll
                 # value must not fall through to the per-process
                 # in-mesh reshape below.
                 new_n = None
               else:
-                new_n = min(max(1, raw // procs), capacity)
+                new_n = value
             # Agreement point: adopt any pending scheduled restart. A
             # schedule whose target equals this incarnation's world is
             # already satisfied (stale key from before the re-exec).

@@ -213,13 +213,47 @@ class ElasticController:
       gen = self._client.current_generation()
       self._client.kv_put(f"kf_restart_sched_{gen}",
                           f"{step}:{target_np}".encode())
-    except Exception:
-      pass  # a sibling's schedule (or a later poll) will carry it
+    except Exception as e:
+      # poll() is one-shot per target (dedup on _last_target), so a
+      # swallowed failure here would drop the resize silently. Reset the
+      # dedup so the next poll re-sees the target and retries the put.
+      import sys
+      print(f"elastic: scheduling restart failed ({e}); will retry on "
+            "the next poll", file=sys.stderr, flush=True)
+      self._last_target = None
 
   def close(self) -> None:
     close = getattr(self._client, "close", None)
     if close:
       close()
+
+
+def plan_resize(raw_target: int, procs: int, capacity: int,
+                max_procs: int):
+  """Classify a RESIZE target under the kfrun launcher.
+
+  ``raw_target`` is the GLOBAL device count the coordinator was asked
+  for; ``procs`` the live process count; ``capacity`` the per-process
+  device capacity (locally attached devices); ``max_procs`` the
+  provisioned host-list length (1 when no distributed world can form).
+
+  Returns ("reshape", per_process_devices) whenever the target FITS the
+  current process set (procs <= target <= procs * capacity) -- an
+  in-mesh re-jit is free compared to a restart, so it always wins when
+  feasible. Otherwise ("restart", required_procs): a live JAX world
+  cannot change its process count, so the job must checkpoint + re-exec
+  at the fewest processes that cover the target (clamped to the
+  provisioned hosts; if clamping lands back on the current count, the
+  best-effort answer is again an in-mesh reshape).
+  """
+  capacity = max(1, capacity)
+  procs = max(1, procs)
+  if procs <= raw_target <= procs * capacity:
+    return "reshape", max(1, raw_target // procs)
+  required = min(max(1, -(-raw_target // capacity)), max(1, max_procs))
+  if required == procs:
+    return "reshape", min(max(1, raw_target // procs), capacity)
+  return "restart", required
 
 
 class ScheduledController:

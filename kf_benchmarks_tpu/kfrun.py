@@ -47,11 +47,14 @@ RESTART_EXIT_CODE = 42
 
 
 def _run_generation(server, np_: int, command: List[str], logdir: str,
-                    host: str, extra_env: Optional[dict]) -> Tuple[int, bool]:
+                    host: str, extra_env: Optional[dict],
+                    generation: int = 0) -> Tuple[int, bool]:
   """Spawn one generation of ``np_`` workers; wait.
 
-  Returns (exit_code, restart_requested). Logs append so a restarted
-  generation's output lands in the same per-worker files."""
+  Returns (exit_code, restart_requested). Generation 0 truncates the
+  per-worker log files (a fresh launch must not accumulate a previous
+  run's output); restart generations append so one job's output stays
+  in one set of files."""
   procs = []
   log_files = []
   try:
@@ -63,11 +66,11 @@ def _run_generation(server, np_: int, command: List[str], logdir: str,
       env["KFCOORD_WORLD"] = str(np_)
       env["KFCOORD_NAME"] = f"worker-{i}"
       env["KFCOORD_RANK_HINT"] = str(i)
-      # Per-process log capture, named the way kungfu-run names them
-      # (append: restart generations continue the same files).
+      # Per-process log capture, named the way kungfu-run names them.
+      mode = "w" if generation == 0 else "a"
       tag = f"{host}.{10000 + i}"
-      out = open(os.path.join(logdir, f"{tag}.stdout.log"), "a")
-      err = open(os.path.join(logdir, f"{tag}.stderr.log"), "a")
+      out = open(os.path.join(logdir, f"{tag}.stdout.log"), mode)
+      err = open(os.path.join(logdir, f"{tag}.stderr.log"), mode)
       log_files += [out, err]
       procs.append(subprocess.Popen(command, env=env, stdout=out,
                                     stderr=err))
@@ -127,9 +130,10 @@ def launch(np_: int, command: List[str], logdir: str = ".",
   server = coordination.CoordinatorServer(port=base_port)
   try:
     gen_np = np_
-    for _ in range(max_restarts + 1):
+    for generation in range(max_restarts + 1):
       code, restart = _run_generation(server, gen_np, command, logdir,
-                                      host, extra_env)
+                                      host, extra_env,
+                                      generation=generation)
       if not restart:
         return code
       # The workers checkpointed and exited for a resize; relaunch at
@@ -145,10 +149,10 @@ def launch(np_: int, command: List[str], logdir: str = ".",
           sched = client.kv_tryget(f"kf_restart_sched_{gen}")
           if sched:
             new_np = max(1, int(sched.decode().partition(":")[2]))
-          else:
-            target = client.try_target_size()
-            if target:
-              new_np = max(1, int(target))
+          # No fallback to try_target_size(): that is a global DEVICE
+          # count, and respawning processes at it churns restarts
+          # forever when capacity > 1 (the workers re-derive the right
+          # process count from a fresh poll after respawn at gen_np).
         except Exception as e:  # noqa: BLE001
           print(f"kfrun: could not read restart target ({e}); "
                 f"respawning at np={gen_np}", file=sys.stderr, flush=True)

@@ -15,22 +15,15 @@ batch) loss keeps falling across the whole arc.
 
 import os
 import re
-import socket
 import sys
 import threading
 import time
 
 import pytest
 
+from tests.test_distributed_training import _free_port
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _free_port() -> int:
-  s = socket.socket()
-  s.bind(("127.0.0.1", 0))
-  port = s.getsockname()[1]
-  s.close()
-  return port
 
 
 @pytest.mark.slow

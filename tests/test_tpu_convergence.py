@@ -14,7 +14,9 @@ CLAUDE.md). All TPU work must be serialized: run this test alone.
 
     KF_TPU_TESTS=1 python -m pytest tests/test_tpu_convergence.py -q
 
-A logged run is committed at experiments/tpu_convergence_smoke.log.
+When the chip is reachable, commit the passing run's output as
+experiments/tpu_convergence_smoke.log (round 3: the tunnel stayed
+wedged, so no hardware log exists yet -- see PERF.md).
 """
 
 import os
