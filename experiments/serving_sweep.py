@@ -28,12 +28,13 @@ TOTAL_RE = re.compile(r"^total images/sec: ([\d.]+)$", re.M)
 
 
 def run_cli(args, timeout=2400):
-  env = dict(os.environ)
-  env.pop("XLA_FLAGS", None)
-  env.pop("JAX_PLATFORMS", None)
+  # Stock environment, like bench.py: JAX_PLATFORMS stays pinned to the
+  # axon plugin (overriding it breaks the relay -- CLAUDE.md); a wedged
+  # tunnel fails the CLI loudly via benchmark.setup()'s probe instead of
+  # silently printing CPU numbers.
   r = subprocess.run([sys.executable, "-m", "kf_benchmarks_tpu.cli"] + args,
                      capture_output=True, text=True, timeout=timeout,
-                     cwd=REPO, env=env)
+                     cwd=REPO, env=dict(os.environ))
   if r.returncode != 0:
     raise RuntimeError(f"{args}: {r.stdout[-2000:]} {r.stderr[-2000:]}")
   m = TOTAL_RE.search(r.stdout)

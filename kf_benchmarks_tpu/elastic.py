@@ -237,19 +237,22 @@ def plan_resize(raw_target: int, procs: int, capacity: int,
   device capacity (locally attached devices); ``max_procs`` the
   provisioned host-list length (1 when no distributed world can form).
 
-  Returns ("reshape", per_process_devices) whenever the target FITS the
-  current process set (procs <= target <= procs * capacity) -- an
-  in-mesh re-jit is free compared to a restart, so it always wins when
-  feasible. Otherwise ("restart", required_procs): a live JAX world
-  cannot change its process count, so the job must checkpoint + re-exec
-  at the fewest processes that cover the target (clamped to the
-  provisioned hosts; if clamping lands back on the current count, the
-  best-effort answer is again an in-mesh reshape).
+  Returns ("reshape", per_process_devices) whenever the target is
+  EXACTLY satisfiable by the current process set (divisible by procs
+  and within per-process capacity) -- an in-mesh re-jit is free compared
+  to a restart, so it wins whenever it hits the requested size.
+  Otherwise ("restart", required_procs): a live JAX world cannot change
+  its process count, so the job must checkpoint + re-exec at the fewest
+  processes that cover the target (a non-divisible target restarts too:
+  the smaller process set can then hit it exactly in-mesh). Clamped to
+  the provisioned hosts; if clamping lands back on the current count,
+  the best-effort answer is a rounded-down in-mesh reshape.
   """
   capacity = max(1, capacity)
   procs = max(1, procs)
-  if procs <= raw_target <= procs * capacity:
-    return "reshape", max(1, raw_target // procs)
+  if (raw_target % procs == 0 and
+      procs <= raw_target <= procs * capacity):
+    return "reshape", raw_target // procs
   required = min(max(1, -(-raw_target // capacity)), max(1, max_procs))
   if required == procs:
     return "reshape", min(max(1, raw_target // procs), capacity)

@@ -48,13 +48,16 @@ RESTART_EXIT_CODE = 42
 
 def _run_generation(server, np_: int, command: List[str], logdir: str,
                     host: str, extra_env: Optional[dict],
-                    generation: int = 0) -> Tuple[int, bool]:
+                    opened_logs: Optional[set] = None) -> Tuple[int, bool]:
   """Spawn one generation of ``np_`` workers; wait.
 
-  Returns (exit_code, restart_requested). Generation 0 truncates the
-  per-worker log files (a fresh launch must not accumulate a previous
-  run's output); restart generations append so one job's output stays
-  in one set of files."""
+  Returns (exit_code, restart_requested). The first time THIS launch
+  opens a worker's log file it truncates it (a fresh launch -- or a
+  restart that grows past the previous world size -- must not
+  accumulate an earlier job's output); later generations append so one
+  job's output stays in one set of files."""
+  if opened_logs is None:
+    opened_logs = set()
   procs = []
   log_files = []
   try:
@@ -67,8 +70,9 @@ def _run_generation(server, np_: int, command: List[str], logdir: str,
       env["KFCOORD_NAME"] = f"worker-{i}"
       env["KFCOORD_RANK_HINT"] = str(i)
       # Per-process log capture, named the way kungfu-run names them.
-      mode = "w" if generation == 0 else "a"
       tag = f"{host}.{10000 + i}"
+      mode = "a" if tag in opened_logs else "w"
+      opened_logs.add(tag)
       out = open(os.path.join(logdir, f"{tag}.stdout.log"), mode)
       err = open(os.path.join(logdir, f"{tag}.stderr.log"), mode)
       log_files += [out, err]
@@ -130,10 +134,11 @@ def launch(np_: int, command: List[str], logdir: str = ".",
   server = coordination.CoordinatorServer(port=base_port)
   try:
     gen_np = np_
-    for generation in range(max_restarts + 1):
+    opened_logs: set = set()
+    for _ in range(max_restarts + 1):
       code, restart = _run_generation(server, gen_np, command, logdir,
                                       host, extra_env,
-                                      generation=generation)
+                                      opened_logs=opened_logs)
       if not restart:
         return code
       # The workers checkpointed and exited for a resize; relaunch at

@@ -110,13 +110,21 @@ class AsyncParameterServerStrategy(ReplicatedStrategy):
   """Async PS (--cross_replica_sync=false, ref: benchmark_cnn.py:520-522).
 
   In the reference every worker applies its own UNAGGREGATED gradient to
-  the one PS-hosted weight copy; the weights stay shared, only the
-  averaging disappears. The SPMD reformulation keeps exactly those two
-  properties: gradients are psum-SUMMED (N sequential unaveraged plain-SGD
-  applications to shared weights collapse into one update by the gradient
-  sum -- validation restricts this mode to --optimizer=sgd, where the
-  collapse is exact), weights and BN stats remain replicated. The
-  reference's timing asynchrony itself (workers at different steps,
+  the one PS-hosted weight + optimizer-state copy; the state stays
+  shared, only the averaging disappears. The SPMD reformulation keeps
+  exactly those properties, by optimizer class:
+
+  * plain SGD: N sequential unaveraged applications to shared weights
+    collapse into ONE update by the gradient SUM -- gradients are
+    psum-summed and applied once (exact, and cheapest).
+  * stateful optimizers (momentum/rmsprop/adam): the collapse does not
+    hold, so ``sequential_apply`` makes the train step all-gather the
+    per-replica gradients and apply them ONE AT A TIME through the
+    shared optimizer state (a lax.scan over replicas) -- a faithful
+    serialization of the PS's nondeterministic interleaving, fixed to
+    replica-index order so every replica computes the identical result.
+
+  The reference's timing asynchrony itself (workers at different steps,
   GlobalStepWatcher) has no SPMD analog -- steps run in lockstep; the
   per-step window math is therefore exact (see KungFuStrategy's
   throughput note)."""
@@ -126,7 +134,17 @@ class AsyncParameterServerStrategy(ReplicatedStrategy):
   # per-worker batch, as the reference's async mode behaves.
   cross_replica = False
 
+  def __init__(self, params=None, reducer=None):
+    super().__init__(params, reducer=reducer)
+    self.sequential_apply = bool(
+        params is not None and getattr(params, "optimizer", "sgd") != "sgd")
+
   def reduce_gradients(self, grads, axis_name=REPLICA_AXIS):
+    if self.sequential_apply:
+      # The train step gathers and serializes these local gradients
+      # through the shared optimizer state; summing here would apply
+      # every gradient n times.
+      return grads
     if self.reducer is not None:
       grads = self.reducer(grads, axis_name)
       n = lax.axis_size(axis_name)

@@ -84,6 +84,30 @@ def l2_loss(params, single_op: bool = False):
                    for l in leaves)
 
 
+def _sync_schedule_counts(src_state, dst_state, bump: int = 0):
+  """Copy every ``count`` leaf of ``src_state`` (+``bump``) into
+  ``dst_state``.
+
+  optax keys schedules and bias correction on the optimizer's internal
+  update count. When one lockstep round applies the optimizer several
+  times (async-PS sequential apply), the framework's time base is still
+  the ROUND: without this, an N-replica round would advance count-keyed
+  LR schedules N times -- decaying N times too early and diverging from
+  the logged lr_fn(step).
+  """
+  src = {jax.tree_util.keystr(p): leaf for p, leaf in
+         jax.tree_util.tree_flatten_with_path(src_state)[0]}
+
+  def fix(path, leaf):
+    if path and getattr(path[-1], "name", None) == "count":
+      return src[jax.tree_util.keystr(path)] + bump
+    return leaf
+
+  flat, treedef = jax.tree_util.tree_flatten_with_path(dst_state)
+  return jax.tree_util.tree_unflatten(
+      treedef, [fix(p, l) for p, l in flat])
+
+
 def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
                   mesh, compute_dtype=jnp.float32, total_train_steps=None):
   """Build (init_fn, train_step, eval_step) jitted over ``mesh``.
@@ -251,8 +275,31 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
 
     model_params_pre = strategy.pre_update(model_params, state.step,
                                            REPLICA_AXIS)
-    updates, new_opt_state = tx.update(grads, opt_state, model_params_pre)
-    new_params = optax.apply_updates(model_params_pre, updates)
+    if getattr(strategy, "sequential_apply", False):
+      # Async PS with a stateful optimizer (strategies.py): serialize
+      # every replica's unaveraged gradient through the SHARED optimizer
+      # state, in replica-index order -- the deterministic SPMD
+      # rendering of the PS's one-at-a-time applications (ref async
+      # mode: benchmark_cnn.py:520-522).
+      g_all = jax.tree.map(
+          lambda g: lax.all_gather(g, REPLICA_AXIS, axis=0), grads)
+
+      def _apply_one(carry, g):
+        prms, ost = carry
+        upd, ost2 = tx.update(g, ost, prms)
+        # Every application within the round sees the ROUND's schedule
+        # count (momentum/variance state still advances per
+        # application); the round bump happens once, below.
+        ost2 = _sync_schedule_counts(ost, ost2)
+        return (optax.apply_updates(prms, upd), ost2), None
+
+      (new_params, new_opt_state), _ = lax.scan(
+          _apply_one, (model_params_pre, opt_state), g_all)
+      new_opt_state = _sync_schedule_counts(opt_state, new_opt_state,
+                                            bump=1)
+    else:
+      updates, new_opt_state = tx.update(grads, opt_state, model_params_pre)
+      new_params = optax.apply_updates(model_params_pre, updates)
     new_params = strategy.post_update(new_params, state.step, REPLICA_AXIS)
     new_bs = strategy.sync_batch_stats(new_bs, REPLICA_AXIS)
 

@@ -81,13 +81,6 @@ def validate_cross_flags(params) -> None:
   if p.fp16_enable_auto_loss_scale and not p.use_fp16:
     raise ParamError("--fp16_enable_auto_loss_scale requires --use_fp16 "
                      "(ref :1334-1336)")
-  if (p.variable_update == "parameter_server" and
-      not p.cross_replica_sync and p.optimizer != "sgd"):
-    raise ParamError(
-        "--cross_replica_sync=false (async PS) requires --optimizer=sgd: "
-        "the SPMD collapse of N sequential unaveraged applications into "
-        "one gradient-sum update is exact only for a stateless "
-        "first-order optimizer (ref async mode: benchmark_cnn.py:520-522)")
   if p.staged_vars and p.variable_update != "parameter_server":
     raise ParamError("--staged_vars is only supported with "
                      "--variable_update=parameter_server (ref :1478-1479)")

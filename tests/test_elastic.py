@@ -160,6 +160,9 @@ def test_plan_resize_decision_matrix():
   assert plan_resize(2, procs=2, capacity=4, max_procs=2) == ("reshape", 1)
   # Below one device per process, the process count must drop.
   assert plan_resize(1, procs=2, capacity=4, max_procs=2) == ("restart", 1)
+  # Non-divisible target: restarting to 1 process lets the mesh hit 3
+  # devices exactly; a 2-process floor-divide would silently deliver 2.
+  assert plan_resize(3, procs=2, capacity=4, max_procs=2) == ("restart", 1)
   # Provisioned-host cap: target 8 at capacity 1 wants 8 procs but only
   # 2 hosts exist -> capped to 2 == current -> reshape (clamped).
   assert plan_resize(8, procs=2, capacity=1, max_procs=2) == ("reshape", 1)
