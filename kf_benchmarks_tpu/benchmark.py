@@ -754,6 +754,13 @@ class BenchmarkCNN:
                "resize polling disabled")
     reshape_events = []
 
+    # Snapshot pre-existing profiler runs so the measured per-op table is
+    # pinned to the trace THIS run captures (a stale dump at the same
+    # --trace_file path must never be reported as this run's profile).
+    trace_dir = observability.trace_dir_of(p.trace_file)
+    pre_trace_runs = (observability.list_profile_runs(trace_dir)
+                      if p.trace_file and p.tfprof_file else [])
+
     log_fn("Running warm up")
     t0 = time.time()
     for w in range(self.num_warmup_batches):
@@ -1013,6 +1020,28 @@ class BenchmarkCNN:
       bench_logger.log_metric("average_examples_per_sec", images_per_sec,
                               unit="examples/sec",
                               global_step=start_step + num_steps)
+    if p.tfprof_file:
+      # The measured half of the tfprof analog (ref: benchmark_cnn.py:
+      # 1208-1228 ranks ops by MEASURED accelerator time from RunMetadata):
+      # parse the step trace captured above back into per-op device time,
+      # next to the static roofline .ops.txt. Without --trace_file this
+      # run captured nothing: no scan (CWD's plugins/profile is not
+      # ours to read), but a stale table a previous traced run left at
+      # the profile path is still cleared. Best-effort throughout -- an
+      # observability failure must never cost a finished run its final
+      # checkpoint below.
+      try:
+        measured_path = p.tfprof_file + ".measured_ops.txt"
+        if p.trace_file:
+          table = observability.dump_measured_op_profile(
+              trace_dir, measured_path, exclude=pre_trace_runs)
+          if table is not None:
+            for line in table.splitlines():
+              log_fn(line)
+        elif os.path.exists(measured_path):
+          os.unlink(measured_path)
+      except Exception as e:  # pragma: no cover - defensive tail
+        log_fn(f"measured per-op profile failed (non-fatal): {e!r}")
     # Final checkpoint (ref: benchmark_cnn.py:2374-2378).
     if p.train_dir:
       checkpoint.save_checkpoint(p.train_dir, state, p.max_ckpts_to_keep)

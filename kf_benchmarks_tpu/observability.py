@@ -40,6 +40,14 @@ import numpy as np
 
 # -- one-step trace (ref: benchmark_cnn.py:270-275) -------------------------
 
+def trace_dir_of(trace_file: Optional[str]) -> str:
+  """The profiler output directory for a --trace_file value. The ONE
+  derivation shared by the capture side (maybe_trace_step) and the
+  readback side (measured per-op table): if they ever diverged, the
+  run-pinning exclude snapshot would silently read the wrong directory."""
+  return os.path.dirname(trace_file or "") or "."
+
+
 @contextlib.contextmanager
 def maybe_trace_step(trace_file: Optional[str], step: int,
                      trace_at_step: int = 0):
@@ -51,7 +59,7 @@ def maybe_trace_step(trace_file: Optional[str], step: int,
   reference's file-path flag shape.
   """
   if trace_file and step == trace_at_step:
-    trace_dir = os.path.dirname(trace_file) or "."
+    trace_dir = trace_dir_of(trace_file)
     os.makedirs(trace_dir, exist_ok=True)
     with jax.profiler.trace(trace_dir):
       yield True
@@ -298,6 +306,127 @@ def per_op_table(hlo_text: str, top_n: int = 20) -> str:
 def dump_per_op_profile(compiled, path: str, top_n: int = 20) -> str:
   """Write the per-op table next to the tfprof cost JSON and return it."""
   table = per_op_table(compiled.as_text(), top_n=top_n)
+  os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+  with open(path, "w") as f:
+    f.write(table + "\n")
+  return table
+
+
+# -- MEASURED per-op profile from the captured trace ------------------------
+# The reference's tfprof read MEASURED accelerator time out of RunMetadata
+# (ref: benchmark_cnn.py:1208-1228); the static roofline table above ranks by
+# estimate only. Here the jax.profiler trace captured under --trace_file is
+# parsed back into measured per-op device time: every complete ("X") trace
+# event whose args carry an ``hlo_op`` key is an XLA op execution on the
+# backend (CPU thunks and TPU device ops both emit them), so durations sum
+# to real measured time -- trip-count-weighted through loops, unlike the
+# static table's counted-once while bodies.
+
+def list_profile_runs(trace_dir: str):
+  """Timestamped profiler run dirs under trace_dir, oldest first.
+  Callers snapshot this BEFORE capturing a trace so the measured table
+  can be pinned to the run this invocation actually wrote (a stale dump
+  from an earlier run at the same path must never masquerade as this
+  run's profile)."""
+  import glob
+  return sorted(glob.glob(os.path.join(trace_dir, "plugins", "profile", "*")))
+
+
+def load_trace_op_events(trace_dir: str, exclude=()):
+  """Op-execution events from the newest profiler dump under trace_dir,
+  skipping any run dir listed in ``exclude`` (pre-existing runs).
+
+  jax.profiler.trace writes plugins/profile/<ts>/<host>.trace.json.gz in
+  Chrome trace-event format. Returns the raw event dicts (ph == "X" with
+  args.hlo_op), or [] when no (new) dump or no op events exist.
+  """
+  import glob
+  import gzip
+  stale = set(exclude)
+  runs = [r for r in list_profile_runs(trace_dir) if r not in stale]
+  if not runs:
+    return []
+  events = []
+  for path in glob.glob(os.path.join(runs[-1], "*.trace.json.gz")):
+    try:
+      with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    except (OSError, ValueError):
+      continue
+    for e in data.get("traceEvents", []):
+      if (e.get("ph") == "X" and
+          isinstance(e.get("args"), dict) and "hlo_op" in e["args"]):
+        events.append(e)
+  return events
+
+
+def measured_op_costs(events):
+  """Aggregate op events -> per-op rows with measured device time.
+
+  Keyed by (hlo_module, hlo_op): two modules in one traced span (e.g. a
+  train step plus a metrics program) can both own a "fusion.1", and
+  merging those would corrupt both rows. Rows carry total microseconds
+  across the whole trace, occurrence count, and per-execution average. A
+  scanned/while-looped op appears once per trip, so totals reflect what
+  the device actually spent.
+  """
+  agg: Dict[Any, Dict[str, Any]] = {}
+  for e in events:
+    name = e["args"]["hlo_op"]
+    module = e["args"].get("hlo_module", "")
+    row = agg.setdefault((module, name),
+                         {"name": name, "total_us": 0.0, "count": 0,
+                          "module": module})
+    row["total_us"] += float(e.get("dur", 0.0))
+    row["count"] += 1
+  rows = list(agg.values())
+  for r in rows:
+    r["avg_us"] = r["total_us"] / max(r["count"], 1)
+  return rows
+
+
+MEASURED_OP_TABLE_HEADER = ("rank     total_us  %total  count       avg_us"
+                            "  op")
+
+
+def measured_per_op_table(trace_dir: str, top_n: int = 20,
+                          exclude=()) -> Optional[str]:
+  """The MEASURED half of the tfprof analog: top-``top_n`` XLA ops by
+  accelerator time summed from the captured profiler trace (ref:
+  benchmark_cnn.py:1208-1228 ranked by measured accelerator time).
+  Returns None when the trace contains no op events (nothing to rank).
+  ``exclude`` lists pre-existing profiler run dirs to ignore."""
+  rows = measured_op_costs(load_trace_op_events(trace_dir, exclude=exclude))
+  if not rows:
+    return None
+  rows.sort(key=lambda r: r["total_us"], reverse=True)
+  total = sum(r["total_us"] for r in rows) or 1.0
+  # Disambiguate op names only when several modules landed in the span.
+  multi_module = len({r["module"] for r in rows}) > 1
+  lines = [f"Top {top_n} ops by MEASURED accelerator time "
+           "(jax.profiler trace of the designated step)",
+           MEASURED_OP_TABLE_HEADER]
+  for rank, r in enumerate(rows[:top_n], 1):
+    name = (f"{r['name']} [{r['module']}]" if multi_module else r["name"])
+    lines.append(
+        f"{rank:4d}  {r['total_us']:11.1f}  {100.0 * r['total_us'] / total:5.1f}%"
+        f"  {r['count']:5d}  {r['avg_us']:11.2f}  {name}")
+  return "\n".join(lines)
+
+
+def dump_measured_op_profile(trace_dir: str, path: str, top_n: int = 20,
+                             exclude=()) -> Optional[str]:
+  """Write the measured per-op table (next to the static .ops.txt) and
+  return it; None when the trace yielded no op events -- in which case
+  any table a PREVIOUS run left at ``path`` is removed too (a stale
+  table must not sit next to this run's fresh .ops.txt)."""
+  table = measured_per_op_table(trace_dir, top_n=top_n, exclude=exclude)
+  if table is None:
+    try:
+      os.unlink(path)
+    except FileNotFoundError:
+      pass
+    return None
   os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
   with open(path, "w") as f:
     f.write(table + "\n")

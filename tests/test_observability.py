@@ -157,6 +157,112 @@ def test_trace_one_step(tmp_path):
   assert found, "expected profiler output files"
 
 
+def test_measured_op_costs_aggregation():
+  """Unit: op events aggregate by hlo_op with trip-count-weighted totals;
+  non-op events (no args.hlo_op) are never loaded in the first place, so
+  the aggregator only sees real executions."""
+  events = [
+      {"ph": "X", "dur": 10.0, "args": {"hlo_op": "fusion.1",
+                                        "hlo_module": "jit_step"}},
+      {"ph": "X", "dur": 30.0, "args": {"hlo_op": "fusion.1",
+                                        "hlo_module": "jit_step"}},
+      {"ph": "X", "dur": 5.0, "args": {"hlo_op": "copy.2",
+                                       "hlo_module": "jit_step"}},
+  ]
+  rows = {r["name"]: r for r in observability.measured_op_costs(events)}
+  assert rows["fusion.1"]["total_us"] == 40.0
+  assert rows["fusion.1"]["count"] == 2
+  assert rows["fusion.1"]["avg_us"] == 20.0
+  assert rows["copy.2"]["total_us"] == 5.0
+
+
+def test_measured_op_costs_keyed_by_module():
+  """Two modules in one traced span can both own a 'fusion.1'; their
+  rows must not merge (and the table disambiguates with [module])."""
+  events = [
+      {"ph": "X", "dur": 10.0, "args": {"hlo_op": "fusion.1",
+                                        "hlo_module": "jit_step"}},
+      {"ph": "X", "dur": 99.0, "args": {"hlo_op": "fusion.1",
+                                        "hlo_module": "jit_metrics"}},
+  ]
+  rows = observability.measured_op_costs(events)
+  assert len(rows) == 2
+  assert {(r["module"], r["total_us"]) for r in rows} == {
+      ("jit_step", 10.0), ("jit_metrics", 99.0)}
+
+
+def test_stale_profiler_run_excluded(tmp_path):
+  """A pre-existing dump at the same trace path must not masquerade as
+  this run's measured profile: runs listed in ``exclude`` are skipped."""
+  import gzip
+  run_dir = tmp_path / "plugins" / "profile" / "2020_01_01_00_00_00"
+  run_dir.mkdir(parents=True)
+  ev = {"traceEvents": [{"ph": "X", "dur": 7.0, "name": "fusion.9",
+                         "args": {"hlo_op": "fusion.9",
+                                  "hlo_module": "jit_old"}}]}
+  with gzip.open(str(run_dir / "host.trace.json.gz"), "wt") as f:
+    json.dump(ev, f)
+  stale = observability.list_profile_runs(str(tmp_path))
+  assert len(stale) == 1
+  # Without exclusion the stale run is readable...
+  assert observability.load_trace_op_events(str(tmp_path))
+  # ...with exclusion it is invisible and no table is produced.
+  assert observability.load_trace_op_events(str(tmp_path),
+                                            exclude=stale) == []
+  assert observability.measured_per_op_table(str(tmp_path),
+                                             exclude=stale) is None
+
+
+def test_measured_per_op_profile_e2e(tmp_path, capsys):
+  """--trace_file + --tfprof_file together emit the MEASURED top-op table
+  (the RunMetadata-read half of the reference's tfprof, ref:
+  benchmark_cnn.py:1208-1228) parsed from the captured profiler trace,
+  next to the static .ops.txt."""
+  trace_file = str(tmp_path / "traces" / "trace")
+  prof = str(tmp_path / "profile.json")
+  _run(tmp_path, model="lenet", trace_file=trace_file, tfprof_file=prof)
+  path = prof + ".measured_ops.txt"
+  assert os.path.exists(path), "measured per-op table not written"
+  lines = open(path).read().splitlines()
+  assert lines[0].startswith("Top 20 ops by MEASURED accelerator time")
+  assert lines[1] == observability.MEASURED_OP_TABLE_HEADER
+  assert len(lines) > 2  # ranked rows from the real trace
+  # Ranked by measured total time, descending, with positive durations
+  # and execution counts.
+  totals = [float(l.split()[1]) for l in lines[2:]]
+  assert totals == sorted(totals, reverse=True)
+  assert all(t > 0 for t in totals)
+  counts = [int(l.split()[3]) for l in lines[2:]]
+  assert all(c >= 1 for c in counts)
+  # Operator-facing: also printed to the step log.
+  out = capsys.readouterr().out
+  assert observability.MEASURED_OP_TABLE_HEADER in out
+
+
+def test_measured_profile_absent_without_trace(tmp_path):
+  """No trace -> no measured table (the static .ops.txt still appears);
+  dump_measured_op_profile returns None rather than writing a header-only
+  file -- and an untraced run REMOVES a stale table a previous traced run
+  left at the same profile path (it must not masquerade as this run's)."""
+  prof = str(tmp_path / "profile.json")
+  stale = prof + ".measured_ops.txt"
+  with open(stale, "w") as f:
+    f.write("previous run's table\n")
+  _run(tmp_path, model="lenet", tfprof_file=prof)
+  assert os.path.exists(prof + ".ops.txt")
+  assert not os.path.exists(stale)
+  assert observability.dump_measured_op_profile(
+      str(tmp_path / "empty"), str(tmp_path / "out.txt")) is None
+  assert not os.path.exists(str(tmp_path / "out.txt"))
+  # A PREVIOUS run's table at the same path is removed, not left to
+  # masquerade as this run's measured profile.
+  stale_path = str(tmp_path / "stale.txt")
+  open(stale_path, "w").write("old table\n")
+  assert observability.dump_measured_op_profile(
+      str(tmp_path / "empty"), stale_path) is None
+  assert not os.path.exists(stale_path)
+
+
 def test_eval_metrics_logged(tmp_path):
   log_dir = str(tmp_path / "bench_logs")
   _run(tmp_path, benchmark_log_dir=log_dir, eval=True,
