@@ -1,0 +1,193 @@
+"""Gossip + hierarchical-reduction scaling on the virtual CPU mesh
+(VERDICT r3 weak #3 / next-round #4): make the log2(n)-sends trade of the
+gated pair_average lowering and the hier-vs-flat-psum cost a MEASURED
+fact, not a code comment.
+
+For n in {8, 16, 32} (32 virtual CPU devices, submeshes for smaller n):
+
+  pair_average  -- switch lowering (n <= GOSSIP_SWITCH_MAX_N: one
+                   tree-sized send/step, n-1 baked branches) vs gated
+                   power-of-two-hop lowering (ceil(log2 n) sends/step,
+                   flat program): HLO bytes, collective_permute count,
+                   and measured step wall time.
+  reducers      -- flat psum vs rsag (#shards) vs hier (grouped ring) on
+                   a 4 MB gradient vector: HLO bytes + step wall time.
+
+Measurement caveat (printed with the table): on this 1-core host the
+virtual devices execute serially in one process, so "step time" measures
+total work+data movement, not parallel wall clock -- exactly the axis the
+log2(n) wire-traffic trade lives on. Run with nothing else on the core.
+
+    python experiments/gossip_hier_scale_probe.py [--repeats 30]
+"""
+
+import argparse
+import os
+import statistics
+import sys
+import time
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=32"
+                           ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # sanctioned flip (CLAUDE.md)
+
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from kf_benchmarks_tpu.ops import allreduce  # noqa: E402
+from kf_benchmarks_tpu.parallel import kungfu  # noqa: E402
+from kf_benchmarks_tpu.parallel.mesh import build_mesh  # noqa: E402
+
+# Per-replica payloads. Gossip moves the WEIGHTS (256 KiB here);
+# reducers move a gradient vector (4 MiB) -- big enough that data
+# movement, not dispatch, dominates on the serial backend.
+GOSSIP_ELEMS = 64 * 1024
+REDUCE_ELEMS = 1024 * 1024
+
+
+def _time_calls(fn, args_fn, repeats):
+  """Median seconds per call; args_fn(i) varies inputs (e.g. the gossip
+  step) so a cached-constant path can't fake the schedule."""
+  jax.block_until_ready(fn(*args_fn(0)))  # warmup/compile
+  times = []
+  for i in range(repeats):
+    a = args_fn(i)
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn(*a))
+    times.append(time.perf_counter() - t0)
+  return statistics.median(times)
+
+
+def gossip_probe(n, switch_max, repeats):
+  """(hlo_bytes, n_permutes, median_step_s) for pair_average at axis
+  size n with GOSSIP_SWITCH_MAX_N pinned to switch_max."""
+  mesh = build_mesh(n, "cpu")
+  old = kungfu.GOSSIP_SWITCH_MAX_N
+  kungfu.GOSSIP_SWITCH_MAX_N = switch_max
+  try:
+    f = jax.jit(jax.shard_map(
+        lambda v, s: kungfu.pair_average(v[0], s)[None], mesh=mesh,
+        in_specs=(P("replica"), P()), out_specs=P("replica")))
+    vals = jnp.ones((n, GOSSIP_ELEMS), jnp.float32)
+    txt = f.lower(jax.ShapeDtypeStruct((n, GOSSIP_ELEMS), jnp.float32),
+                  jax.ShapeDtypeStruct((), jnp.int32)).compile().as_text()
+    med = _time_calls(f, lambda i: (vals, jnp.int32(i)), repeats)
+  finally:
+    kungfu.GOSSIP_SWITCH_MAX_N = old
+  return len(txt), txt.count("collective-permute"), med
+
+
+REDUCERS = {
+    "psum": allreduce._pmean_direct,
+    "rsag": lambda v, ax: allreduce._rsag(v, ax, shards=1),
+    "hier": lambda v, ax: allreduce._hier(v, ax, num_groups=4),
+}
+
+
+def reducer_probe(n, spec, repeats):
+  """(hlo_bytes, median_step_s) for an allreduce alg at axis size n."""
+  mesh = build_mesh(n, "cpu")
+  red = REDUCERS[spec]
+  f = jax.jit(jax.shard_map(
+      lambda v: red(v[0], "replica")[None], mesh=mesh,
+      in_specs=(P("replica"),), out_specs=P("replica")))
+  vals = jnp.ones((n, REDUCE_ELEMS), jnp.float32)
+  txt = f.lower(jax.ShapeDtypeStruct(
+      (n, REDUCE_ELEMS), jnp.float32)).compile().as_text()
+  med = _time_calls(f, lambda i: (vals,), repeats)
+  return len(txt), med
+
+
+def async_ps_probe(n, sequential, repeats):
+  """(median_step_s) for the async-PS update path at axis size n: the
+  sequential_apply pattern (all-gather n gradient trees + lax.scan of n
+  momentum applications through shared optimizer state,
+  train_step.py:278-299) vs the synchronous collapse (one pmean + one
+  application). 1M-float parameter vector."""
+  import optax
+  from jax import lax
+  mesh = build_mesh(n, "cpu")
+  tx = optax.sgd(0.1, momentum=0.9)
+  elems = 1024 * 1024
+
+  def seq_step(prms, g, ost):
+    # The optimizer state enters unvarying (P()); the scan carry becomes
+    # replica-varying after the first update, so mark it varying up front
+    # (shard_map's scan-vma rule).
+    ost = jax.tree.map(
+        lambda x: lax.pcast(x, ("replica",), to="varying"), ost)
+    g_all = lax.all_gather(g, "replica", axis=0)
+
+    def one(carry, gi):
+      pr, st = carry
+      upd, st2 = tx.update(gi, st, pr)
+      return (optax.apply_updates(pr, upd), st2), None
+
+    (prms, ost), _ = lax.scan(one, (prms, ost), g_all)
+    return prms
+
+  def sync_step(prms, g, ost):
+    g = lax.pmean(g, "replica")
+    upd, _ = tx.update(g, ost, prms)
+    return optax.apply_updates(prms, upd)
+
+  step = seq_step if sequential else sync_step
+  f = jax.jit(jax.shard_map(
+      lambda p_, g, o: step(p_[0], g[0], o)[None], mesh=mesh,
+      in_specs=(P("replica"), P("replica"), P()), out_specs=P("replica")))
+  prms = jnp.ones((n, elems), jnp.float32)
+  grads = jnp.ones((n, elems), jnp.float32)
+  ost = tx.init(jnp.ones((elems,), jnp.float32))
+  return _time_calls(f, lambda i: (prms, grads, ost), repeats)
+
+
+def main():
+  ap = argparse.ArgumentParser(description=__doc__)
+  ap.add_argument("--repeats", type=int, default=30)
+  ap.add_argument("--only", choices=("gossip", "reduce", "asyncps"),
+                  default=None)
+  args = ap.parse_args()
+
+  print(f"devices: {len(jax.devices())} virtual CPU on {os.cpu_count()} "
+        "core(s) -- serial emulation; step time = total work, "
+        "not parallel wall clock\n")
+
+  if args.only in (None, "gossip"):
+    print("## pair_average: switch vs gated lowering")
+    print("| n | lowering | HLO bytes | collective-permutes | step ms |")
+    print("|---|---|---|---|---|")
+    for n in (8, 16, 32):
+      for label, switch_max in (("switch (1 send)", n),
+                                ("gated log2 hops", 1)):
+        hlo, nperm, med = gossip_probe(n, switch_max, args.repeats)
+        print(f"| {n} | {label} | {hlo} | {nperm} | {med * 1e3:.2f} |",
+              flush=True)
+
+  if args.only in (None, "reduce"):
+    print("\n## all-reduce: flat psum vs rsag vs hier (4 MiB/replica)")
+    print("| n | spec | HLO bytes | step ms |")
+    print("|---|---|---|---|")
+    for n in (8, 16, 32):
+      for spec in ("psum", "rsag", "hier"):
+        hlo, med = reducer_probe(n, spec, args.repeats)
+        print(f"| {n} | {spec} | {hlo} | {med * 1e3:.2f} |", flush=True)
+
+  if args.only in (None, "asyncps"):
+    print("\n## async-PS sequential apply vs synchronous collapse "
+          "(momentum, 4 MiB params)")
+    print("| n | mode | step ms |")
+    print("|---|---|---|")
+    for n in (2, 4, 8, 16):
+      for label, seq in (("sequential (async-PS stateful)", True),
+                         ("one collapsed update (sync)", False)):
+        med = async_ps_probe(n, seq, max(args.repeats // 3, 5))
+        print(f"| {n} | {label} | {med * 1e3:.2f} |", flush=True)
+
+
+if __name__ == "__main__":
+  main()

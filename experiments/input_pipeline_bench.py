@@ -89,7 +89,7 @@ def main():
   ap.add_argument("--batch", type=int, default=256)
   ap.add_argument("--distortions", action="store_true")
   ap.add_argument("--mode", default="both",
-                  choices=("thread", "process", "both"))
+                  choices=("thread", "process", "both", "dispatch"))
   ap.add_argument("--workers", type=int, default=0,
                   help="0 = auto (cpu count)")
   args = ap.parse_args()
@@ -119,6 +119,24 @@ def main():
       results["process_pool"] = measure(pre, d, args.batch)
       print(f"process_pool: {results['process_pool']:.1f} images/sec",
             flush=True)
+    if args.mode == "dispatch":
+      # Parent-side dispatch cost (VERDICT r3 next #3): staging records
+      # into the shared input ring + the per-slice enqueues, isolated
+      # from decode by the pool's own dispatch_seconds accounting.
+      # Workers contend for this 1-core host's CPU, so throughput is
+      # NOT the point here; the dispatcher cost per batch is.
+      print("| workers | dispatch ms/batch | dispatch-bound img/s "
+            "ceiling | measured img/s |")
+      print("|---|---|---|---|")
+      for k in (1, 2, 4):
+        pre = preprocessing.MultiprocessImagePreprocessor(
+            args.batch, (224, 224, 3), train=True,
+            distortions=args.distortions, num_processes=k)
+        ips = measure(pre, d, args.batch)
+        ms = 1e3 * pre.dispatch_seconds / max(pre.dispatch_calls, 1)
+        ceiling = args.batch / (ms / 1e3) if ms else float("inf")
+        print(f"| {k} | {ms:.2f} | {ceiling:.0f} | {ips:.0f} |",
+              flush=True)
   return results
 
 

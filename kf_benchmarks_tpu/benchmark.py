@@ -982,6 +982,20 @@ class BenchmarkCNN:
                 new_n or self.num_devices)
             if proposed != self.batch_size_per_device:
               new_bs = proposed
+          if new_n:
+            # A resize must honor the same cross-flag rules as startup
+            # (e.g. the async-PS sequential-apply device cap): an
+            # in-mesh up-resize is the one path that changes num_devices
+            # without re-running startup validation, so check here and
+            # hold topology rather than grow into a configuration the
+            # CLI would have rejected.
+            try:
+              validation.validate_cross_flags(
+                  self.params._replace(num_devices=new_n))
+            except validation.ParamError as e:
+              log_fn(f"Elastic reshape to {new_n} devices rejected by "
+                     f"flag validation ({e}); keeping current topology")
+              new_n = None
           if new_n or new_bs:
             event = {"step": i + 1,
                      "num_devices": new_n or self.num_devices,
@@ -1144,7 +1158,8 @@ class BenchmarkCNN:
         try:
           path, _ = checkpoint.latest_checkpoint(p.train_dir)
           state = checkpoint.restore_state(state,
-                                           checkpoint.load_checkpoint(path))
+                                           checkpoint.load_checkpoint(path),
+                                           restore_opt_state=False)
         except checkpoint.CheckpointNotFoundException:
           pass
       variables = {"params": jax.tree.map(lambda x: x[0], state.params)}
@@ -1206,7 +1221,10 @@ class BenchmarkCNN:
             return results
           time.sleep(p.eval_interval_secs or 1)
           continue
-        state = checkpoint.restore_state(state, snapshot)
+        # Model variables only: the eval process's optimizer flags need
+        # not match the trainer's (the eval graph has no slots to fill).
+        state = checkpoint.restore_state(state, snapshot,
+                                         restore_opt_state=False)
         log_fn(f"Evaluating checkpoint at global step {ckpt_step}")
         results = self._eval_pass(state, eval_step, data_rng)
         results["global_step"] = ckpt_step

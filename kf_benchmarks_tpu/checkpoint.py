@@ -167,15 +167,23 @@ def _reseed_staged(buffers, params):
   return buffers
 
 
-def restore_state(state, snapshot: dict):
+def restore_state(state, snapshot: dict, restore_opt_state: bool = True):
   """Rebuild a stacked device TrainState from a host snapshot: replica-0
   values are broadcast to every replica (the restore-side analog of the
-  reference's post-init v0->v* copy, variable_mgr.py:342-356)."""
+  reference's post-init v0->v* copy, variable_mgr.py:342-356).
+
+  ``restore_opt_state=False`` restores model variables only -- the eval
+  path's semantic (the reference's eval graph holds no optimizer slots,
+  so its Saver restore never touches them, ref benchmark_cnn.py:
+  1829-1862): an eval process must be able to read a checkpoint written
+  under ANY optimizer, not just the one its own flags happen to default
+  to."""
   params = _restack(state.params, snapshot["params"])
   return state.replace(
       step=jnp.asarray(snapshot["step"], jnp.int32),
       params=params,
-      opt_state=_restack(state.opt_state, snapshot["opt_state"]),
+      opt_state=(_restack(state.opt_state, snapshot["opt_state"])
+                 if restore_opt_state else state.opt_state),
       batch_stats=_restack(state.batch_stats, snapshot["batch_stats"]),
       loss_scale=jnp.asarray(snapshot["loss_scale"], jnp.float32),
       loss_scale_normal_steps=jnp.asarray(

@@ -127,7 +127,13 @@ class AsyncParameterServerStrategy(ReplicatedStrategy):
   The reference's timing asynchrony itself (workers at different steps,
   GlobalStepWatcher) has no SPMD analog -- steps run in lockstep; the
   per-step window math is therefore exact (see KungFuStrategy's
-  throughput note)."""
+  throughput note).
+
+  Cost: ``sequential_apply`` is O(n) optimizer applications per step plus
+  an all-gather of n full gradient trees -- a CORRECTNESS mode, not a
+  scaling mode. validation.py caps it at
+  ASYNC_PS_SEQUENTIAL_MAX_DEVICES; the measured cost curve vs n is in
+  PERF.md (async-PS micro-benchmark)."""
 
   name = "parameter_server(async)"
   # Unaveraged gradients: the effective step scale follows the

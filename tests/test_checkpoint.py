@@ -90,6 +90,19 @@ def test_eval_reads_checkpoint(tmp_path):
   assert 0.0 <= stats["top_1_accuracy"] <= 1.0
 
 
+def test_eval_restores_across_optimizers(tmp_path):
+  """An eval process must read a checkpoint written under ANY optimizer
+  (the reference's eval graph has no optimizer slots to restore, ref:
+  benchmark_cnn.py:1829-1862). Regression for the round-4 TPU smoke:
+  momentum-trained checkpoint + default-sgd eval run crashed on the
+  opt_state structure mismatch."""
+  tmp = str(tmp_path / "train")
+  _train(tmp, optimizer="momentum")  # snapshot carries momentum traces
+  stats, _ = _train(tmp, eval=True, num_eval_batches=2, num_batches=None)
+  assert stats["global_step"] == 4
+  assert 0.0 <= stats["top_1_accuracy"] <= 1.0
+
+
 def test_eval_without_checkpoint_raises(tmp_path):
   with pytest.raises(checkpoint.CheckpointNotFoundException):
     _train(str(tmp_path / "empty"), eval=True, num_eval_batches=1,

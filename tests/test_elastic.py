@@ -88,6 +88,26 @@ def test_scheduled_resize_mid_run():
   assert np.isfinite(stats["last_average_loss"])
 
 
+def test_resize_respects_cross_flag_validation(monkeypatch):
+  """An in-mesh up-resize must honor the same cross-flag rules as
+  startup: async PS + stateful optimizer may not grow past
+  ASYNC_PS_SEQUENTIAL_MAX_DEVICES via the elastic path (the one route
+  that changes num_devices without re-running validation). The resize is
+  rejected, topology holds, the run completes."""
+  from kf_benchmarks_tpu import validation
+  monkeypatch.setattr(validation, "ASYNC_PS_SEQUENTIAL_MAX_DEVICES", 2)
+  bench = _make_bench(variable_update="parameter_server",
+                      cross_replica_sync=False, optimizer="momentum",
+                      num_devices=2, num_batches=8,
+                      elastic_check_every_n_steps=4)
+  bench.elastic_controller = elastic.ScheduledController({4: 4})
+  stats = bench.run()
+  assert bench.num_devices == 2          # held, not grown
+  assert stats["reshape_events"] == []
+  assert stats["num_steps"] == 8
+  assert np.isfinite(stats["last_average_loss"])
+
+
 def test_scheduled_shrink_mid_run():
   bench = _make_bench(num_batches=10, num_devices=4,
                       elastic_check_every_n_steps=5)

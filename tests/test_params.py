@@ -96,5 +96,23 @@ class TestCrossFlagValidation:
     with pytest.raises(ParamError):
       validate_cross_flags(p)
 
+  def test_async_ps_stateful_optimizer_capped(self):
+    """Async PS + stateful optimizer is O(n) sequential optimizer
+    applications per step (train_step.py sequential_apply); worlds above
+    ASYNC_PS_SEQUENTIAL_MAX_DEVICES are rejected up front, while sgd
+    (exact single-update collapse) and bounded worlds pass."""
+    from kf_benchmarks_tpu import validation
+    big = validation.ASYNC_PS_SEQUENTIAL_MAX_DEVICES + 1
+    p = params.make_params(variable_update="parameter_server",
+                           cross_replica_sync=False, optimizer="momentum",
+                           num_devices=big)
+    with pytest.raises(ParamError, match="sequentially"):
+      validate_cross_flags(p)
+    validate_cross_flags(p._replace(optimizer="sgd"))
+    validate_cross_flags(p._replace(
+        num_devices=validation.ASYNC_PS_SEQUENTIAL_MAX_DEVICES))
+    # Synchronous PS at the same scale is unaffected.
+    validate_cross_flags(p._replace(cross_replica_sync=True))
+
   def test_clean_params_pass(self):
     validate_cross_flags(params.make_params(model="resnet50", num_batches=10))

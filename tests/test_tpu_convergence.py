@@ -108,3 +108,11 @@ def test_tpu_real_data_train_and_eval(tmp_path):
   top1 = float(m.group(1))
   # Well above the 10% chance floor on the class-colored data.
   assert top1 >= 0.3, (top1, eval_out[-2000:])
+  # Persist the hardware evidence (the committed artifact the round-3
+  # verdict asked for): train step lines + eval accuracy, as emitted.
+  with open(os.path.join(REPO, "experiments",
+                         "tpu_convergence_smoke.log"), "w") as f:
+    f.write("# train leg (real chip, real-data cifar10 path)\n")
+    f.write(out)
+    f.write("\n# eval leg (checkpoint restore, model variables only)\n")
+    f.write(eval_out)
