@@ -8,10 +8,16 @@ width. This probe measures that remaining corner:
 
 * Same halo layout / roll structure as the stage-3 probe, at
   x[256,56,56,64] * w[3,3,64,64] (the 3x3 of every stage-2 bottleneck).
-* **K-packing**: C=64 fills half the 128-lane MXU width, so taps are
-  paired -- concat two rolled operands along channels (3364,128) against
-  the two taps' stacked weights (128,64) -- restoring full-width
-  matmuls: 4 pairs + 1 single per output tile.
+* **N-packing**: C=64 fills half the 128-lane MXU width, so taps are
+  paired along the OUTPUT dimension -- one matmul of the shared operand
+  against two taps' weights concatenated to (64,128), then the two f32
+  output halves are rolled into place separately (roll commutes with
+  row-wise matmul, the stage-3 trick): 4 pairs + 1 single per tile.
+  (The first attempt packed along K -- concat two differently-rolled
+  operands along lanes -- which Mosaic miscompiled: the TPU build
+  produced wrong values for the concat of roll-offset layouts while
+  interpret mode matched XLA to bf16 rounding.  N-packing keeps every
+  concat on host-side weights and every roll on a plain f32 value.)
 * Same differential timing (scan K units, difference two K values) and
   the same three arms: fused kernel, XLA full unit, XLA relu+conv only.
 
@@ -30,10 +36,11 @@ B, H, W, C = 256, 56, 56, 64
 CO = 64
 Hp, Wp = H + 2, W + 2
 ROWS = Hp * Wp  # 3364 flattened halo rows per image
-IMGS = 2        # images per grid step (VMEM: ~0.9 MB per f32 plane)
+IMGS = 1        # images per grid step (VMEM: ~0.9 MB per f32 plane;
+                # 2 images + f32 temporaries exceeded the 16M scoped limit)
 N_VALID = float(B * H * W)
 
-# Tap pairing for K-packed matmuls: 4 pairs + 1 single (tap 8).
+# Tap pairing for N-packed matmuls: 4 pairs + 1 single (tap 8).
 PAIRS = [(0, 1), (2, 3), (4, 5), (6, 7)]
 SINGLE = 8
 
@@ -51,10 +58,10 @@ def _tap_off(t):
 
 
 def fused_kernel(x_ref, wp_ref, ws_ref, st_in_ref, m_ref, y_ref, st_ref):
-  """One stage-2 conv+BN unit with K-packed tap pairs.
+  """One stage-2 conv+BN unit with N-packed tap pairs.
 
   x_ref:     (IMGS, ROWS, C)   raw halo-layout input
-  wp_ref:    (4, 2*C, CO)      stacked weights for the 4 tap pairs
+  wp_ref:    (4, C, 2*CO)      CO-concatenated weights for the 4 pairs
   ws_ref:    (C, CO)           weights for the single tap 8
   st_in_ref: (2, C)            input BN statistics [sum, sumsq]
   m_ref:     (ROWS, 1)         interior-row mask
@@ -76,21 +83,23 @@ def fused_kernel(x_ref, wp_ref, ws_ref, st_in_ref, m_ref, y_ref, st_ref):
   s_sq = jnp.zeros((1, CO), jnp.float32)
   for i in range(IMGS):
     x = x_ref[i].astype(jnp.float32)
-    xn = jnp.maximum(x * sc + sh, 0.0) * mask
+    xn = (jnp.maximum(x * sc + sh, 0.0) * mask).astype(jnp.bfloat16)
 
-    def rolled(t):
+    def place(out, t):
+      # roll(A) @ W == roll(A @ W) along rows: shift the f32 output so
+      # row r accumulates the tap's contribution from row r + off.
       off = _tap_off(t)
-      src = pltpu.roll(xn, (ROWS - off) % ROWS, 0) if off else xn
-      return src.astype(jnp.bfloat16)
+      return pltpu.roll(out, (ROWS - off) % ROWS, 0) if off else out
 
     acc = jnp.zeros((ROWS, CO), jnp.float32)
-    # K-packed pairs: concat two rolled operands along channels so the
-    # matmul runs at the full 128-lane MXU width.
+    # N-packed pairs: one matmul against two taps' weights side by side
+    # runs the MXU at full 128-lane output width; the halves then roll
+    # into place independently.
     for p, (ta, tb) in enumerate(PAIRS):
-      packed = jnp.concatenate([rolled(ta), rolled(tb)], axis=1)
-      acc += jnp.dot(packed, wp_ref[p], preferred_element_type=jnp.float32)
-    acc += jnp.dot(rolled(SINGLE), ws_ref[...],
-                   preferred_element_type=jnp.float32)
+      out = jnp.dot(xn, wp_ref[p], preferred_element_type=jnp.float32)
+      acc += place(out[:, :CO], ta) + place(out[:, CO:], tb)
+    acc += place(jnp.dot(xn, ws_ref[...],
+                         preferred_element_type=jnp.float32), SINGLE)
     y_ref[i] = acc.astype(y_ref.dtype)
     vacc = acc * mask
     s_sum += jnp.sum(vacc, axis=0, keepdims=True)
@@ -106,7 +115,7 @@ def pallas_unit(x, wp, ws, st_in, mask):
       grid=(B // IMGS,),
       in_specs=[
           pl.BlockSpec((IMGS, ROWS, C), lambda b: (b, 0, 0)),
-          pl.BlockSpec((4, 2 * C, CO), lambda b: (0, 0, 0)),
+          pl.BlockSpec((4, C, 2 * CO), lambda b: (0, 0, 0)),
           pl.BlockSpec((C, CO), lambda b: (0, 0)),
           pl.BlockSpec((2, C), lambda b: (0, 0)),
           pl.BlockSpec((ROWS, 1), lambda b: (0, 0)),
@@ -120,13 +129,14 @@ def pallas_unit(x, wp, ws, st_in, mask):
           jax.ShapeDtypeStruct((2, CO), jnp.float32),
       ],
       compiler_params=pltpu.CompilerParams(
-          dimension_semantics=("arbitrary",)),
+          dimension_semantics=("arbitrary",),
+          vmem_limit_bytes=64 * 1024 * 1024),
   )(x, wp, ws, st_in, mask)
 
 
 def pack_weights(w9):
-  """(9, C, CO) -> pair-stacked (4, 2C, CO) + single (C, CO)."""
-  wp = jnp.stack([jnp.concatenate([w9[a], w9[b]], axis=0)
+  """(9, C, CO) -> pair-concatenated (4, C, 2CO) + single (C, CO)."""
+  wp = jnp.stack([jnp.concatenate([w9[a], w9[b]], axis=1)
                   for a, b in PAIRS])
   return wp, w9[SINGLE]
 
@@ -214,7 +224,7 @@ def main():
     return min(ts)
 
   flops = 2 * B * H * W * C * CO * 9
-  arms = (("pallas fused (K-packed)", lambda k: pal_rep(to_halo(x), wp, ws, k)),
+  arms = (("pallas fused (N-packed)", lambda k: pal_rep(to_halo(x), wp, ws, k)),
           ("xla unfused            ", lambda k: xla_rep(x, w9, k)),
           ("xla relu+conv only     ", lambda k: xla_conv_only_rep(x, w9, k)))
   for name, f in arms:

@@ -44,6 +44,10 @@ def main():
   ap.add_argument("--batches", type=int, default=30)
   ap.add_argument("--bs", type=int, default=64)
   ap.add_argument("--images", type=int, default=768)
+  ap.add_argument("--preprocessor", default="multiprocess")
+  ap.add_argument("--workers", type=int, default=0,
+                  help="decode workers/threads (0 = pipeline default); on "
+                  "this 1-core host >1 worker only adds contention")
   args = ap.parse_args()
 
   with tempfile.TemporaryDirectory() as d:
@@ -51,11 +55,13 @@ def main():
     print(f"fixture: {args.images} JPEGs", flush=True)
     r = subprocess.run(
         [sys.executable, "-m", "kf_benchmarks_tpu.cli",
-         "--model=resnet50", f"--data_dir={d}",
+         "--model=resnet50", f"--data_dir={d}", "--data_name=imagenet",
          "--device=tpu", "--num_devices=1", f"--batch_size={args.bs}",
          f"--num_batches={args.batches}", "--num_warmup_batches=2",
          "--display_every=5", "--use_fp16=true", "--optimizer=momentum",
-         "--input_preprocessor=multiprocess", "--nodistortions"],
+         f"--input_preprocessor={args.preprocessor}", "--nodistortions"]
+        + ([f"--datasets_num_private_threads={args.workers}"]
+           if args.workers else []),
         capture_output=True, text=True, timeout=3600, cwd=REPO,
         env=dict(os.environ))
   sys.stderr.write(r.stdout[-4000:] + r.stderr[-2000:])

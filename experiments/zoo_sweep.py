@@ -1,0 +1,93 @@
+"""Model-zoo training throughput sweep on the real chip.
+
+The reference publishes multi-model throughput tables (tf_cnn_benchmarks
+README methodology: alexnet/googlenet/vgg16/inception3/resnet50/... at
+fixed per-device batch sizes); our hardware evidence so far covers
+resnet50 (+3 north-star configs).  This sweep runs the whole classic
+image zoo through the stock CLI on the real chip -- one SERIALIZED
+subprocess per point, synthetic data, bf16 training step -- and prints
+the markdown table for PERF.md.
+
+Batch sizes follow the reference's per-GPU conventions where they fit
+v5e HBM (resnet50 @ 256 is the measured optimum; vgg/inception @ 128;
+inception4/resnet152 @ 64 for activation footprint; alexnet @ 512 as in
+the classic table).
+
+    python experiments/zoo_sweep.py [--batches 40] [--only resnet50 ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from experiments.serving_sweep import run_cli  # noqa: E402
+
+# (model, batch_size, extra CLI args)
+ZOO = [
+    ("alexnet", 512, []),
+    ("googlenet", 128, []),
+    ("overfeat", 256, []),
+    ("vgg16", 128, []),
+    ("inception3", 128, []),
+    ("inception4", 64, []),
+    ("resnet50", 256, []),
+    ("resnet50_v1.5", 256, []),
+    ("resnet101", 128, []),
+    ("resnet152", 64, []),
+    ("mobilenet", 256, []),
+]
+
+
+def main():
+  ap = argparse.ArgumentParser(description=__doc__)
+  ap.add_argument("--batches", type=int, default=40)
+  ap.add_argument("--warmup", type=int, default=5)
+  ap.add_argument("--only", nargs="*", default=None)
+  ap.add_argument("--device", default="tpu")
+  args = ap.parse_args()
+
+  if args.only:
+    known = {m for m, _, _ in ZOO}
+    bad = set(args.only) - known
+    if bad:
+      raise SystemExit(f"unknown --only models {sorted(bad)}; "
+                       f"choose from {sorted(known)}")
+
+  rows = []
+  for model, bs, extra in ZOO:
+    if args.only and model not in args.only:
+      continue
+    cli = [f"--model={model}", f"--batch_size={bs}",
+           f"--device={args.device}", "--num_devices=1",
+           f"--num_batches={args.batches}",
+           f"--num_warmup_batches={args.warmup}",
+           "--use_fp16=true", "--optimizer=momentum",
+           "--display_every=10"] + extra
+    try:
+      ips = run_cli(cli, timeout=3600)
+    except (RuntimeError, subprocess.TimeoutExpired) as e:
+      # A single slow/failed point must not discard the completed
+      # serialized TPU runs -- record it and keep sweeping.
+      print(f"{model}: FAILED -- {e}", flush=True)
+      rows.append((model, bs, None))
+      continue
+    rows.append((model, bs, ips))
+    print(f"{model} bs={bs}: {ips:.0f} img/s "
+          f"({1e3 * bs / ips:.2f} ms/step)", flush=True)
+
+  print("\n| model | bs | img/s | ms/step |")
+  print("|---|---|---|---|")
+  for model, bs, ips in rows:
+    if ips is None:
+      print(f"| {model} | {bs} | failed | - |")
+    else:
+      print(f"| {model} | {bs} | {ips:.0f} | {1e3 * bs / ips:.2f} |")
+
+
+if __name__ == "__main__":
+  main()
