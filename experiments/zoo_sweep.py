@@ -40,6 +40,11 @@ ZOO = [
     ("resnet101", 128, []),
     ("resnet152", 64, []),
     ("mobilenet", 256, []),
+    # Non-image families (synthetic inputs come from each model's
+    # get_synthetic_inputs; "img/s" reads examples/s).
+    ("ssd300", 32, ["--data_name=coco"]),
+    ("deepspeech2", 32, ["--data_name=librispeech", "--optimizer=adam"]),
+    ("ncf", 16384, ["--optimizer=adam", "--weight_decay=0"]),
 ]
 
 
