@@ -1,0 +1,119 @@
+"""Long-context attention on the real chip: blockwise (flash-style)
+vs full attention across sequence lengths.
+
+The claim under test (parallel/sequence.py): the online-softmax
+blockwise schedule keeps peak memory O(L * block) so context lengths
+that are impossible for full attention's (L, L) score tensor train on
+one chip -- the single-device leg of the framework's long-context
+design (ring_attention is the multi-chip leg; its schedule is this one
+plus ppermute).
+
+Method (CLAUDE.md TPU rules): single serialized process; differential
+timing -- scan K attention calls inside one jit, force a scalar, and
+difference two K values to cancel the ~70 ms tunnel RTT; nothing else
+runs on the host during the window.
+
+    python experiments/long_context_probe.py [--dtype bf16]
+
+Prints a markdown table (ms/step and tokens/s per L, both arms) for
+PERF.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+from kf_benchmarks_tpu.parallel import sequence
+
+B, H, D = 1, 8, 128
+BLOCK = 512
+
+
+def make_rep(impl, l, dtype):
+  ks = jax.random.split(jax.random.PRNGKey(0), 3)
+  q, k, v = (jax.random.normal(kk, (B, l, H, D), dtype) for kk in ks)
+
+  if impl == "full":
+    attn = lambda q, k, v: sequence.full_attention(q, k, v, causal=True)
+  else:
+    attn = lambda q, k, v: sequence.blockwise_attention(
+        q, k, v, block_size=BLOCK, causal=True)
+
+  @functools.partial(jax.jit, static_argnums=(3,))
+  def rep(q, k, v, reps):
+    def body(c, _):
+      out = attn(c, k, v)
+      # Feed the output back as the next query so the scan chains on
+      # the device (nothing constant-folds away).
+      return out, None
+    y, _ = jax.lax.scan(body, q, None, length=reps)
+    return jnp.sum(y.astype(jnp.float32))
+
+  return rep, (q, k, v)
+
+
+REPS_SMALL, REPS_BIG = 2, 10
+
+
+def sync_time(f, args, reps, iters=4):
+  float(f(*args, reps))
+  ts = []
+  for _ in range(iters):
+    t0 = time.time()
+    float(f(*args, reps))
+    ts.append(time.time() - t0)
+  return min(ts)
+
+
+def measure(impl, l, dtype):
+  rep, args = make_rep(impl, l, dtype)
+  t_small = sync_time(rep, args, REPS_SMALL)
+  t_big = sync_time(rep, args, REPS_BIG)
+  return (t_big - t_small) / (REPS_BIG - REPS_SMALL)
+
+
+def main():
+  ap = argparse.ArgumentParser(description=__doc__)
+  ap.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
+  ap.add_argument("--lengths", type=int, nargs="+",
+                  default=[2048, 4096, 8192, 16384, 32768, 65536])
+  args = ap.parse_args()
+  dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+
+  print(f"devices: {jax.devices()}")
+  rows = []
+  for l in args.lengths:
+    row = {"L": l}
+    for impl in ("full", "blockwise"):
+      try:
+        dt = measure(impl, l, dtype)
+        row[impl] = dt
+        print(f"L={l} {impl}: {dt*1e3:.2f} ms ({l/dt:,.0f} tok/s)",
+              flush=True)
+      except Exception as e:  # noqa: BLE001 -- OOM is an expected arm
+        row[impl] = None
+        print(f"L={l} {impl}: FAILED ({type(e).__name__}: "
+              f"{str(e)[:120]})", flush=True)
+    rows.append(row)
+
+  print(f"\nB={B} H={H} D={D} block={BLOCK} dtype={args.dtype}, causal")
+  print("| L | full ms | full tok/s | blockwise ms | blockwise tok/s |")
+  print("|---|---|---|---|---|")
+  for r in rows:
+    cells = []
+    for impl in ("full", "blockwise"):
+      if r[impl] is None:
+        cells += ["OOM", "-"]
+      else:
+        cells += [f"{r[impl]*1e3:.2f}", f"{r['L']/r[impl]:,.0f}"]
+    print(f"| {r['L']} | {cells[0]} | {cells[1]} | {cells[2]} | "
+          f"{cells[3]} |")
+
+
+if __name__ == "__main__":
+  main()

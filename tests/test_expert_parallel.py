@@ -1,0 +1,107 @@
+"""Expert parallelism: switch-routed MoE vs a hand-rolled token loop.
+
+Beyond-reference capability (the reference has no conditional
+computation); the SPMD all_to_all dispatch/combine is equivalence-
+tested against a per-token Python loop with identical capacity
+ordering, on the 8-device virtual mesh -- the repo's standard
+numerical-equivalence layering (SURVEY 4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from kf_benchmarks_tpu.parallel import expert
+
+
+def _mesh(n=8):
+  return Mesh(np.array(jax.devices()[:n]), (expert.EXPERT_AXIS,))
+
+
+def _weights(key, e=8, d=8, d_ff=16):
+  ks = jax.random.split(key, 5)
+  return {
+      "gate_w": jax.random.normal(ks[0], (d, e), jnp.float32) * 0.5,
+      "w1": jax.random.normal(ks[1], (e, d, d_ff), jnp.float32) * 0.3,
+      "b1": jax.random.normal(ks[2], (e, d_ff), jnp.float32) * 0.1,
+      "w2": jax.random.normal(ks[3], (e, d_ff, d), jnp.float32) * 0.3,
+      "b2": jax.random.normal(ks[4], (e, d), jnp.float32) * 0.1,
+  }
+
+
+@pytest.mark.parametrize("capacity", [2, 4, 64])
+def test_switch_moe_matches_token_loop(capacity):
+  n, tokens_per_dev, d = 8, 16, 8
+  w = _weights(jax.random.PRNGKey(0), d=d)
+  x = jax.random.normal(jax.random.PRNGKey(1), (n * tokens_per_dev, d),
+                        jnp.float32)
+
+  fn = expert.make_switch_moe(_mesh(n), capacity=capacity)
+  got, got_aux = fn(x, w["gate_w"], w["w1"], w["b1"], w["w2"], w["b2"])
+
+  want, want_aux = expert.reference_switch_moe(
+      np.asarray(x).reshape(n, tokens_per_dev, d), w["gate_w"],
+      w["w1"], w["b1"], w["w2"], w["b2"], capacity)
+  np.testing.assert_allclose(
+      np.asarray(got).reshape(n, tokens_per_dev, d), want,
+      rtol=1e-5, atol=1e-5)
+  np.testing.assert_allclose(float(got_aux), want_aux, rtol=1e-5)
+
+
+def test_switch_moe_drops_over_capacity_tokens():
+  # Route everything to expert 0 with a tiny capacity: per source
+  # device, exactly `capacity` tokens survive.
+  n, tokens_per_dev, d, capacity = 8, 8, 8, 2
+  w = _weights(jax.random.PRNGKey(2), d=d)
+  w["gate_w"] = w["gate_w"].at[:].set(0.0).at[0, 0].set(50.0)
+  x = jnp.abs(jax.random.normal(jax.random.PRNGKey(3),
+                                (n * tokens_per_dev, d))) + 0.5
+
+  fn = expert.make_switch_moe(_mesh(n), capacity=capacity)
+  out, _ = fn(x, w["gate_w"], w["w1"], w["b1"], w["w2"], w["b2"])
+  out = np.asarray(out).reshape(n, tokens_per_dev, d)
+  nonzero = (np.abs(out).sum(-1) > 1e-9).sum(axis=1)
+  np.testing.assert_array_equal(nonzero, np.full(n, capacity))
+
+
+def test_switch_moe_gradients_match_token_loop():
+  n, tokens_per_dev, d, capacity = 8, 4, 8, 4
+  w = _weights(jax.random.PRNGKey(4), d=d)
+  x = jax.random.normal(jax.random.PRNGKey(5), (n * tokens_per_dev, d),
+                        jnp.float32)
+  fn = expert.make_switch_moe(_mesh(n), capacity=capacity)
+
+  def par_loss(w1, w2):
+    out, aux = fn(x, w["gate_w"], w1, w["b1"], w2, w["b2"])
+    return jnp.sum(out ** 2) + 0.01 * aux
+
+  # jnp reference with identical math (vectorised form of the token
+  # loop), differentiable for the grad comparison.
+  def ref_loss(w1, w2):
+    total = 0.0
+    aux = 0.0
+    e_global = w["gate_w"].shape[1]
+    xg = x.reshape(n, tokens_per_dev, d)
+    for g in range(n):
+      logits = xg[g] @ w["gate_w"]
+      probs = jax.nn.softmax(logits, axis=-1)
+      idx = jnp.argmax(probs, axis=-1)
+      assign = jax.nn.one_hot(idx, e_global)
+      pos = jnp.cumsum(assign, axis=0) - 1.0
+      keep = assign * (pos < capacity)
+      gate = jnp.max(probs, axis=-1)
+      h = jax.nn.gelu(jnp.einsum("td,edf->tef", xg[g], w1) + w["b1"])
+      y = jnp.einsum("tef,efd->ted", h, w2) + w["b2"]
+      picked = jnp.einsum("te,ted->td", keep, y) * gate[:, None]
+      total = total + jnp.sum(picked ** 2)
+      aux = aux + e_global * jnp.sum(
+          jnp.mean(assign, 0) * jnp.mean(probs, 0))
+    return total + 0.01 * aux / n
+
+  want = jax.grad(ref_loss, argnums=(0, 1))(w["w1"], w["w2"])
+  got = jax.grad(par_loss, argnums=(0, 1))(w["w1"], w["w2"])
+  for g, r in zip(got, want):
+    np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                               rtol=1e-4, atol=1e-4)

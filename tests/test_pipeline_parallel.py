@@ -1,0 +1,100 @@
+"""Pipeline parallelism: SPMD GPipe schedule vs the sequential stack.
+
+Beyond-reference capability (the reference has no inter-layer
+pipelining, SURVEY 2.3); equivalence-tested against running the same
+stages sequentially on one device, forward and backward, on the
+8-device virtual mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from kf_benchmarks_tpu.parallel import pipeline
+
+
+def _mesh(n=8):
+  return Mesh(np.array(jax.devices()[:n]), (pipeline.STAGE_AXIS,))
+
+
+def _stage_fn(params, x):
+  w, b = params["w"], params["b"]
+  return jnp.tanh(x @ w + b)
+
+
+def _stacked_params(key, stages, d):
+  kw, kb = jax.random.split(key)
+  return {
+      "w": jax.random.normal(kw, (stages, d, d), jnp.float32) * 0.3,
+      "b": jax.random.normal(kb, (stages, d), jnp.float32) * 0.1,
+  }
+
+
+def _sequential(params, x, stages):
+  for i in range(stages):
+    x = _stage_fn(jax.tree.map(lambda p: p[i], params), x)
+  return x
+
+
+@pytest.mark.parametrize("num_microbatches", [8, 16])
+def test_pipeline_matches_sequential(num_microbatches):
+  stages, d, batch = 8, 8, 32
+  params = _stacked_params(jax.random.PRNGKey(0), stages, d)
+  x = jax.random.normal(jax.random.PRNGKey(1), (batch, d), jnp.float32)
+
+  want = _sequential(params, x, stages)
+  fn = pipeline.make_pipeline(_mesh(), _stage_fn, num_microbatches)
+  got = fn(params, x)
+  np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                             rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_gradients_match_sequential():
+  stages, d, batch, m = 8, 4, 16, 8
+  params = _stacked_params(jax.random.PRNGKey(2), stages, d)
+  x = jax.random.normal(jax.random.PRNGKey(3), (batch, d), jnp.float32)
+
+  def ref_loss(params):
+    return jnp.sum(_sequential(params, x, stages) ** 2)
+
+  fn = pipeline.make_pipeline(_mesh(), _stage_fn, m)
+
+  def par_loss(params):
+    return jnp.sum(fn(params, x) ** 2)
+
+  want = jax.grad(ref_loss)(params)
+  got = jax.grad(par_loss)(params)
+  for k in ("w", "b"):
+    np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_pipeline_rejects_indivisible_batch():
+  fn = pipeline.make_pipeline(_mesh(), _stage_fn, num_microbatches=3)
+  params = _stacked_params(jax.random.PRNGKey(4), 8, 4)
+  x = jnp.zeros((8, 4), jnp.float32)
+  with pytest.raises(ValueError, match="not divisible"):
+    fn(params, x)
+
+
+def test_pipeline_rejects_stage_count_mismatch():
+  # 16 stacked stages over 8 devices would shard 2-per-device and
+  # silently drop half the layers; it must refuse instead.
+  fn = pipeline.make_pipeline(_mesh(), _stage_fn, num_microbatches=4)
+  params = _stacked_params(jax.random.PRNGKey(6), 16, 4)
+  x = jnp.zeros((8, 4), jnp.float32)
+  with pytest.raises(ValueError, match="one stage per device"):
+    fn(params, x)
+
+
+def test_pipeline_program_is_one_scan():
+  # The schedule must be a single scan of M+S-1 ticks, not an unrolled
+  # tower: the while-loop appears once in the per-device HLO.
+  stages, d, batch, m = 8, 4, 16, 4
+  params = _stacked_params(jax.random.PRNGKey(5), stages, d)
+  x = jnp.zeros((batch, d), jnp.float32)
+  fn = pipeline.make_pipeline(_mesh(), _stage_fn, m)
+  hlo = fn.lower(params, x).compile().as_text()
+  assert hlo.count("while(") == 1, hlo.count("while(")
