@@ -23,7 +23,11 @@ from __future__ import annotations
 
 import argparse
 import functools
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -31,10 +35,10 @@ import jax.numpy as jnp
 from kf_benchmarks_tpu.parallel import sequence
 
 B, H, D = 1, 8, 128
-BLOCK = 512
+BLOCK = 512  # default; --block overrides
 
 
-def make_rep(impl, l, dtype):
+def make_rep(impl, l, dtype, block=BLOCK):
   ks = jax.random.split(jax.random.PRNGKey(0), 3)
   q, k, v = (jax.random.normal(kk, (B, l, H, D), dtype) for kk in ks)
 
@@ -42,7 +46,7 @@ def make_rep(impl, l, dtype):
     attn = lambda q, k, v: sequence.full_attention(q, k, v, causal=True)
   else:
     attn = lambda q, k, v: sequence.blockwise_attention(
-        q, k, v, block_size=BLOCK, causal=True)
+        q, k, v, block_size=block, causal=True)
 
   @functools.partial(jax.jit, static_argnums=(3,))
   def rep(q, k, v, reps):
@@ -57,10 +61,16 @@ def make_rep(impl, l, dtype):
   return rep, (q, k, v)
 
 
-REPS_SMALL, REPS_BIG = 2, 10
+def _reps_for(l):
+  """(small, big, iters): one attention call at L=32k runs ~10 s of MXU
+  work, so the chained-rep counts shrink as L grows to keep each arm's
+  wall time bounded while the differential still cancels the RTT."""
+  if l >= 16384:
+    return 1, 3, 2
+  return 2, 10, 4
 
 
-def sync_time(f, args, reps, iters=4):
+def sync_time(f, args, reps, iters):
   float(f(*args, reps))
   ts = []
   for _ in range(iters):
@@ -70,11 +80,12 @@ def sync_time(f, args, reps, iters=4):
   return min(ts)
 
 
-def measure(impl, l, dtype):
-  rep, args = make_rep(impl, l, dtype)
-  t_small = sync_time(rep, args, REPS_SMALL)
-  t_big = sync_time(rep, args, REPS_BIG)
-  return (t_big - t_small) / (REPS_BIG - REPS_SMALL)
+def measure(impl, l, dtype, block=BLOCK):
+  reps_small, reps_big, iters = _reps_for(l)
+  rep, args = make_rep(impl, l, dtype, block)
+  t_small = sync_time(rep, args, reps_small, iters)
+  t_big = sync_time(rep, args, reps_big, iters)
+  return (t_big - t_small) / (reps_big - reps_small)
 
 
 def main():
@@ -82,6 +93,7 @@ def main():
   ap.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
   ap.add_argument("--lengths", type=int, nargs="+",
                   default=[2048, 4096, 8192, 16384, 32768, 65536])
+  ap.add_argument("--block", type=int, default=BLOCK)
   args = ap.parse_args()
   dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
 
@@ -91,7 +103,7 @@ def main():
     row = {"L": l}
     for impl in ("full", "blockwise"):
       try:
-        dt = measure(impl, l, dtype)
+        dt = measure(impl, l, dtype, args.block)
         row[impl] = dt
         print(f"L={l} {impl}: {dt*1e3:.2f} ms ({l/dt:,.0f} tok/s)",
               flush=True)
@@ -101,7 +113,7 @@ def main():
               f"{str(e)[:120]})", flush=True)
     rows.append(row)
 
-  print(f"\nB={B} H={H} D={D} block={BLOCK} dtype={args.dtype}, causal")
+  print(f"\nB={B} H={H} D={D} block={args.block} dtype={args.dtype}, causal")
   print("| L | full ms | full tok/s | blockwise ms | blockwise tok/s |")
   print("|---|---|---|---|---|")
   for r in rows:
