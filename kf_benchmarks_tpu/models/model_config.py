@@ -24,6 +24,7 @@ from kf_benchmarks_tpu.models import official_resnet_model
 from kf_benchmarks_tpu.models import overfeat_model
 from kf_benchmarks_tpu.models import resnet_model
 from kf_benchmarks_tpu.models import ssd_model
+from kf_benchmarks_tpu.models import transformer_lm
 from kf_benchmarks_tpu.models import trivial_model
 from kf_benchmarks_tpu.models import vgg_model
 
@@ -42,6 +43,7 @@ _model_name_to_imagenet_model: Dict[str, Callable] = {
     "nasnet": nasnet_model.create_nasnet_model,
     "nasnetlarge": nasnet_model.create_nasnetlarge_model,
     "ncf": official_ncf_model.create_ncf_model,
+    "transformer_lm": transformer_lm.create_transformer_lm_model,
     "resnet50": resnet_model.create_resnet50_model,
     "resnet50_v1.5": resnet_model.create_resnet50_v15_model,
     "resnet50_v2": resnet_model.create_resnet50_v2_model,

@@ -1,0 +1,140 @@
+"""Transformer language model -- the zoo's long-context family.
+
+BEYOND-REFERENCE: the reference zoo (ref: scripts/tf_cnn_benchmarks/
+models/model_config.py:38-142) has no transformer/LM family; this model
+makes the framework's long-context machinery reachable through the
+stock CLI like any other zoo member:
+
+    python -m kf_benchmarks_tpu.cli --model=transformer_lm \
+        --batch_size=8 --use_fp16=true
+
+A GPT-style decoder-only LM (pre-LN blocks, learned positions) whose
+attention core is ``parallel/sequence.blockwise_attention`` -- the
+flash-style online-softmax schedule measured in PERF.md (exact causal
+attention at 64k tokens on one 16 GB chip, 2-4x faster than
+materialised-score attention at every length). Synthetic data follows
+the NCF/DeepSpeech pattern: int32 token ids ride the feature slot,
+next-token ids the label slot; throughput prints as sequences/sec on
+the standard step line (x seq_len for tokens/sec).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from kf_benchmarks_tpu.models import model as model_lib
+from kf_benchmarks_tpu.parallel import sequence as sequence_lib
+
+VOCAB = 32768
+SEQ_LEN = 2048
+D_MODEL = 512
+N_LAYERS = 6
+N_HEADS = 8
+D_FF = 2048
+ATTN_BLOCK = 512
+
+
+class _TransformerLMModule(nn.Module):
+  vocab: int = VOCAB
+  d_model: int = D_MODEL
+  n_layers: int = N_LAYERS
+  n_heads: int = N_HEADS
+  d_ff: int = D_FF
+  attn_block: int = ATTN_BLOCK
+  max_len: int = SEQ_LEN
+  dtype: Any = jnp.float32
+  param_dtype: Any = jnp.float32
+
+  @nn.compact
+  def __call__(self, tokens):
+    tokens = tokens.astype(jnp.int32)
+    b, t = tokens.shape
+    head_dim = self.d_model // self.n_heads
+    dense = lambda feats, name, bias=True: nn.Dense(
+        feats, use_bias=bias, name=name, dtype=self.dtype,
+        param_dtype=self.param_dtype)
+    # LayerNorm computes in f32 (bf16 mean/variance loses too much);
+    # the surrounding denses cast back down.
+    ln = lambda name: nn.LayerNorm(name=name, dtype=jnp.float32,
+                                   param_dtype=self.param_dtype)
+
+    x = nn.Embed(self.vocab, self.d_model, name="embed",
+                 dtype=self.dtype, param_dtype=self.param_dtype)(tokens)
+    pos = self.param(
+        "pos_embedding",
+        nn.initializers.normal(0.02, self.param_dtype),
+        (self.max_len, self.d_model))
+    x = x + pos[:t].astype(self.dtype)
+
+    for i in range(self.n_layers):
+      h = ln(f"ln1_{i}")(x).astype(self.dtype)
+      qkv = dense(3 * self.d_model, f"qkv_{i}", bias=False)(h)
+      qkv = qkv.reshape(b, t, 3, self.n_heads, head_dim)
+      att = sequence_lib.blockwise_attention(
+          qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+          block_size=min(self.attn_block, t), causal=True)
+      x = x + dense(self.d_model, f"attn_out_{i}")(
+          att.reshape(b, t, self.d_model))
+      h = ln(f"ln2_{i}")(x).astype(self.dtype)
+      h = nn.gelu(dense(self.d_ff, f"mlp_up_{i}")(h))
+      x = x + dense(self.d_model, f"mlp_down_{i}")(h)
+
+    x = ln("ln_f")(x)
+    logits = nn.Dense(self.vocab, use_bias=False, name="lm_head",
+                      dtype=jnp.float32,
+                      param_dtype=self.param_dtype)(x)
+    return logits.astype(jnp.float32), None
+
+
+class TransformerLMModel(model_lib.Model):
+  """Decoder-only LM over synthetic token streams (no reference
+  counterpart; the zoo's long-context member)."""
+
+  def __init__(self, params=None):
+    super().__init__("transformer_lm", batch_size=8, learning_rate=0.05,
+                     fp16_loss_scale=128, params=params)
+
+  def make_module(self, nclass, phase_train, data_format="NHWC",
+                  dtype=jnp.float32, param_dtype=jnp.float32):
+    del nclass, phase_train, data_format
+    return _TransformerLMModule(dtype=dtype, param_dtype=param_dtype)
+
+  def get_input_shapes(self, subset):
+    n = self.get_batch_size()
+    return [[n, SEQ_LEN], [n, SEQ_LEN]]
+
+  def get_input_data_types(self, subset):
+    return [jnp.int32, jnp.int32]
+
+  def get_synthetic_inputs(self, rng, nclass):
+    n = self.get_batch_size()
+    tokens = jax.random.randint(rng, (n, SEQ_LEN), 0, VOCAB, jnp.int32)
+    # Next-token labels: the shifted stream, so the synthetic objective
+    # is the real LM objective (learnable, not pure noise).
+    labels = jnp.roll(tokens, -1, axis=1)
+    return tokens, labels
+
+  def loss_function(self, build_network_result, labels):
+    logits, _ = build_network_result.logits
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32),
+                             axis=-1)
+    return -jnp.mean(ll)
+
+  def accuracy_function(self, build_network_result, labels):
+    logits, _ = build_network_result.logits
+    labels = labels.astype(jnp.int32)
+    top1 = jnp.mean((jnp.argmax(logits, -1) == labels).astype(
+        jnp.float32))
+    top5 = jnp.mean(jnp.any(
+        jax.lax.top_k(logits, 5)[1] == labels[..., None],
+        axis=-1).astype(jnp.float32))
+    return {"top_1_accuracy": top1, "top_5_accuracy": top5}
+
+
+def create_transformer_lm_model(params=None):
+  return TransformerLMModel(params=params)

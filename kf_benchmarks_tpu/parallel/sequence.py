@@ -64,6 +64,20 @@ def full_attention(q, k, v, causal: bool = False,
   return out.astype(q.dtype)
 
 
+def _vary_like(ref, arrays, default_axes=()):
+  """pcast zero-initialised accumulators to ``ref``'s varying set.
+
+  Inside a shard_map body the Q operand is device-varying and so are
+  the softmax accumulators after one update; constants must be pcast
+  up front or scan/cond type checks reject the carry. ``default_axes``
+  applies when ref carries no vma information (identity if also empty).
+  """
+  vma = tuple(sorted(getattr(ref.aval, "vma", ()))) or tuple(default_axes)
+  if not vma:
+    return arrays
+  return tuple(lax.pcast(x, vma, to="varying") for x in arrays)
+
+
 def _block_update(q, k, v, m, l, o, scale, mask):
   """One online-softmax accumulation step over a K/V block.
 
@@ -107,19 +121,14 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
   scale = (1.0 / math.sqrt(d)) if scale is None else scale
 
   b, h = q.shape[0], q.shape[2]
-
-  # pcast: the accumulators become device-varying inside the loop, and
-  # the skip-conditional's branches must agree on that type from step 0.
-  # They inherit q's full varying set -- under a composed mesh
-  # (e.g. dp x sp x tp) q varies over more axes than the ring's own.
-  vary_axes = tuple(sorted(getattr(q.aval, "vma", ()) or (axis_name,)))
-
-  def _vary(x):
-    return lax.pcast(x, vary_axes, to="varying")
-
-  m = _vary(jnp.full((b, h, tq), _NEG, jnp.float32))
-  l = _vary(jnp.zeros((b, h, tq), jnp.float32))
-  o = _vary(jnp.zeros((b, tq, h, d), jnp.float32))
+  # Under a composed mesh (e.g. dp x sp x tp) q varies over more axes
+  # than the ring's own, and the accumulators must match from step 0.
+  m, l, o = _vary_like(
+      q,
+      (jnp.full((b, h, tq), _NEG, jnp.float32),
+       jnp.zeros((b, h, tq), jnp.float32),
+       jnp.zeros((b, tq, h, d), jnp.float32)),
+      default_axes=(axis_name,))
 
   kc, vc = k, v
   perm = [(i, (i + 1) % n) for i in range(n)]
@@ -173,9 +182,11 @@ def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
   kb = k.reshape(b, nblk, block_size, h, d).swapaxes(0, 1)
   vb = v.reshape(b, nblk, block_size, h, d).swapaxes(0, 1)
 
-  m0 = jnp.full((b, h, l), _NEG, jnp.float32)
-  l0 = jnp.zeros((b, h, l), jnp.float32)
-  o0 = jnp.zeros((b, l, h, d), jnp.float32)
+  m0, l0, o0 = _vary_like(
+      q,
+      (jnp.full((b, h, l), _NEG, jnp.float32),
+       jnp.zeros((b, h, l), jnp.float32),
+       jnp.zeros((b, l, h, d), jnp.float32)))
   qpos = jnp.arange(l)
 
   def step(carry, inp):

@@ -35,6 +35,7 @@ SLOW_TESTS = [
     "tests/test_models.py",
     "tests/test_coco_pipeline.py",
     "tests/test_strategies.py",
+    "tests/test_transformer_lm_e2e.py",
 ]
 
 

@@ -1,0 +1,45 @@
+"""Transformer LM zoo family: registry, shapes, loss/accuracy.
+
+BEYOND-REFERENCE family (no reference counterpart; the long-context
+member of the zoo). The full-size e2e leg lives in
+test_transformer_lm_e2e.py (slow suite).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kf_benchmarks_tpu.models import model_config
+from kf_benchmarks_tpu.models import transformer_lm
+
+
+def test_registered_in_zoo():
+  model = model_config.get_model_config("transformer_lm", "synthetic")
+  assert model.get_name() == "transformer_lm"
+  assert model.get_input_shapes("train") == [
+      [8, transformer_lm.SEQ_LEN], [8, transformer_lm.SEQ_LEN]]
+
+
+def test_module_shapes_and_loss():
+  # Scaled-down module instance (the full-size config is exercised by
+  # the slow e2e leg below; at CPU speeds it takes minutes).
+  vocab, t = 128, 64
+  module = transformer_lm._TransformerLMModule(
+      vocab=vocab, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+      attn_block=16, max_len=t, dtype=jnp.bfloat16)
+  tokens = jax.random.randint(jax.random.PRNGKey(0), (2, t), 0, vocab)
+  labels = jnp.roll(tokens, -1, axis=1)
+  variables = module.init({"params": jax.random.PRNGKey(1)}, tokens)
+  logits, aux = module.apply(variables, tokens)
+  assert aux is None
+  assert logits.shape == (2, t, vocab)
+  assert logits.dtype == jnp.float32
+  from kf_benchmarks_tpu.models.model import BuildNetworkResult
+  model = model_config.get_model_config("transformer_lm", "synthetic")
+  result = BuildNetworkResult(logits=(logits, aux))
+  loss = model.loss_function(result, labels)
+  # Untrained uniform-ish logits: CE near ln(vocab).
+  assert np.isfinite(float(loss))
+  assert abs(float(loss) - np.log(vocab)) < 1.0
+  acc = model.accuracy_function(result, labels)
+  assert 0.0 <= float(acc["top_1_accuracy"]) <= 1.0

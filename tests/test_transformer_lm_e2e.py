@@ -1,0 +1,24 @@
+"""Transformer LM end-to-end through the stock benchmark path.
+
+Full-size config (512-d, 6 layers, 32k vocab, 2k context) through
+BenchmarkCNN on the virtual mesh -- minutes on CPU, so it lives in the
+slow suite (run_tests.py SLOW_TESTS) like the whole-zoo build test.
+"""
+
+import numpy as np
+import pytest
+
+from kf_benchmarks_tpu import benchmark
+from kf_benchmarks_tpu import params as params_lib
+
+
+@pytest.mark.slow
+def test_trains_through_stock_benchmark_path():
+  # One DP train step over 2 virtual devices through BenchmarkCNN --
+  # the same path the CLI takes (tokens ride the image slot, int32).
+  stats = benchmark.BenchmarkCNN(params_lib.make_params(
+      model="transformer_lm", batch_size=2, num_batches=2,
+      num_warmup_batches=0, device="cpu", num_devices=2,
+      variable_update="replicated", optimizer="sgd",
+      display_every=1)).run()
+  assert np.isfinite(stats["last_average_loss"])
