@@ -84,10 +84,13 @@ class _TransformerLMModule(nn.Module):
       x = x + dense(self.d_model, f"mlp_down_{i}")(h)
 
     x = ln("ln_f")(x)
+    # The head computes in the model dtype: at 32k vocab an f32 logits
+    # tensor is the HBM peak (measured OOM at bs=8 on 16 GB, PERF.md);
+    # the loss upcasts per sequence chunk instead.
     logits = nn.Dense(self.vocab, use_bias=False, name="lm_head",
-                      dtype=jnp.float32,
-                      param_dtype=self.param_dtype)(x)
-    return logits.astype(jnp.float32), None
+                      dtype=self.dtype,
+                      param_dtype=self.param_dtype)(x.astype(self.dtype))
+    return logits, None
 
 
 class TransformerLMModel(model_lib.Model):
@@ -118,16 +121,41 @@ class TransformerLMModel(model_lib.Model):
     labels = jnp.roll(tokens, -1, axis=1)
     return tokens, labels
 
+  # Sequence-chunk size for the loss: the f32 softmax temps live one
+  # chunk at a time ((B, 256, 32768) f32 = 268 MB at bs 8) instead of
+  # the whole (B, T, V) tensor, and jax.checkpoint makes the backward
+  # recompute per chunk rather than keep every chunk's softmax alive.
+  LOSS_CHUNK = 256
+
   def loss_function(self, build_network_result, labels):
     logits, _ = build_network_result.logits
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32),
-                             axis=-1)
-    return -jnp.mean(ll)
+    labels = labels.astype(jnp.int32)
+    b, t, v = logits.shape
+    # Largest divisor of t within LOSS_CHUNK, so the bounded-memory
+    # guarantee holds for EVERY sequence length (never a silent
+    # full-tensor fallback; worst case chunk=1).
+    chunk = max(c for c in range(1, min(self.LOSS_CHUNK, t) + 1)
+                if t % c == 0)
+    lc = logits.reshape(b, t // chunk, chunk, v).swapaxes(0, 1)
+    yc = labels.reshape(b, t // chunk, chunk).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def body(carry, xs):
+      lg, yy = xs
+      logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+      ll = jnp.take_along_axis(logp, yy[..., None], axis=-1)
+      return carry + jnp.sum(ll), None
+
+    (zero,) = sequence_lib.vary_like(logits,
+                                     (jnp.zeros((), jnp.float32),))
+    total, _ = jax.lax.scan(body, zero, (lc, yc))
+    return -total / (b * t)
 
   def accuracy_function(self, build_network_result, labels):
     logits, _ = build_network_result.logits
     labels = labels.astype(jnp.int32)
+    # argmax/top_k reduce away the vocab axis chunk-free (no f32
+    # upcast of the full logits tensor is ever materialised).
     top1 = jnp.mean((jnp.argmax(logits, -1) == labels).astype(
         jnp.float32))
     top5 = jnp.mean(jnp.any(

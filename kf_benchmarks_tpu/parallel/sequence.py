@@ -64,7 +64,7 @@ def full_attention(q, k, v, causal: bool = False,
   return out.astype(q.dtype)
 
 
-def _vary_like(ref, arrays, default_axes=()):
+def vary_like(ref, arrays, default_axes=()):
   """pcast zero-initialised accumulators to ``ref``'s varying set.
 
   Inside a shard_map body the Q operand is device-varying and so are
@@ -123,7 +123,7 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
   b, h = q.shape[0], q.shape[2]
   # Under a composed mesh (e.g. dp x sp x tp) q varies over more axes
   # than the ring's own, and the accumulators must match from step 0.
-  m, l, o = _vary_like(
+  m, l, o = vary_like(
       q,
       (jnp.full((b, h, tq), _NEG, jnp.float32),
        jnp.zeros((b, h, tq), jnp.float32),
@@ -182,7 +182,7 @@ def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
   kb = k.reshape(b, nblk, block_size, h, d).swapaxes(0, 1)
   vb = v.reshape(b, nblk, block_size, h, d).swapaxes(0, 1)
 
-  m0, l0, o0 = _vary_like(
+  m0, l0, o0 = vary_like(
       q,
       (jnp.full((b, h, l), _NEG, jnp.float32),
        jnp.zeros((b, h, l), jnp.float32),

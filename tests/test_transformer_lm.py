@@ -33,7 +33,9 @@ def test_module_shapes_and_loss():
   logits, aux = module.apply(variables, tokens)
   assert aux is None
   assert logits.shape == (2, t, vocab)
-  assert logits.dtype == jnp.float32
+  # Head computes in model dtype (f32 logits were the measured HBM
+  # peak); the loss upcasts per chunk.
+  assert logits.dtype == jnp.bfloat16
   from kf_benchmarks_tpu.models.model import BuildNetworkResult
   model = model_config.get_model_config("transformer_lm", "synthetic")
   result = BuildNetworkResult(logits=(logits, aux))
@@ -43,3 +45,28 @@ def test_module_shapes_and_loss():
   assert abs(float(loss) - np.log(vocab)) < 1.0
   acc = model.accuracy_function(result, labels)
   assert 0.0 <= float(acc["top_1_accuracy"]) <= 1.0
+
+
+def test_chunked_loss_matches_unchunked():
+  from kf_benchmarks_tpu.models.model import BuildNetworkResult
+  model = model_config.get_model_config("transformer_lm", "synthetic")
+  b, t, v = 2, 64, 96
+  logits = jax.random.normal(jax.random.PRNGKey(0), (b, t, v),
+                             jnp.float32)
+  labels = jax.random.randint(jax.random.PRNGKey(1), (b, t), 0, v)
+
+  def unchunked(lg):
+    logp = jax.nn.log_softmax(lg, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[..., None], -1))
+
+  def chunked(lg):
+    model.LOSS_CHUNK = 16  # t=64 divides: exercises the scan path
+    return model.loss_function(
+        BuildNetworkResult(logits=(lg, None)), labels)
+
+  np.testing.assert_allclose(float(chunked(logits)),
+                             float(unchunked(logits)), rtol=1e-6)
+  g_c = jax.grad(chunked)(logits)
+  g_u = jax.grad(unchunked)(logits)
+  np.testing.assert_allclose(np.asarray(g_c), np.asarray(g_u),
+                             rtol=1e-5, atol=1e-7)
