@@ -5,8 +5,9 @@ scripts/tf_cnn_benchmarks/variable_mgr.py) and the KungFu distributed
 runtime surface (SURVEY 2.9) with SPMD designs over a jax.sharding.Mesh.
 
 Beyond the reference's batch-only parallelism, the model-parallel axes
-are first-class: sequence/context (`sequence.py`: ring, Ulysses,
-single-chip blockwise attention), tensor (`tensor.py`: Megatron
+are first-class: sequence/context (`sequence.py`: ring, zigzag
+load-balanced causal ring, Ulysses, single-chip blockwise attention),
+tensor (`tensor.py`: Megatron
 column/row sharding), pipeline (`pipeline.py`: SPMD GPipe), expert
 (`expert.py`: Switch MoE), and their dp x sp x tp composition
 (`transformer.py`).

@@ -161,6 +161,103 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
   return out.astype(q.dtype)
 
 
+def zigzag_order(seq_len: int, n: int):
+  """Permutation putting stripe pair (j, 2n-1-j) on device j.
+
+  The causal load-balance placement (Megatron context-parallel style):
+  the global sequence is cut into 2n stripes; device j's contiguous
+  shard becomes [stripe j, stripe 2n-1-j], pairing an early stripe
+  (little causal work) with a late one (much causal work) so every
+  device executes ~2 block updates per ring step instead of device
+  n-1 executing all n. Apply with jnp.take along the sequence axis
+  before sharding; invert with ``zigzag_inverse``.
+  """
+  if seq_len % (2 * n) != 0:
+    raise ValueError(f"seq len {seq_len} not divisible by 2n={2 * n}")
+  t = seq_len // (2 * n)
+  order = []
+  for j in range(n):
+    order.extend(range(j * t, (j + 1) * t))
+    order.extend(range((2 * n - 1 - j) * t, (2 * n - j) * t))
+  return jnp.asarray(order)
+
+
+def zigzag_inverse(seq_len: int, n: int):
+  order = zigzag_order(seq_len, n)
+  inv = jnp.zeros_like(order)
+  return inv.at[order].set(jnp.arange(seq_len))
+
+
+def ring_attention_zigzag(q, k, v, axis_name: str = SEQ_AXIS,
+                          scale: Optional[float] = None):
+  """Causal ring attention over ZIGZAG-placed shards, load-balanced.
+
+  Local shards are [stripe idx, stripe 2n-1-idx] of the zigzag_order
+  permutation (length 2t each). Per ring step each device runs two
+  block updates (three on its one diagonal step src == idx) -- (2n+1)
+  total per device, identical for every idx -- where the contiguous
+  placement leaves device n-1 doing all n updates while device 0 idles
+  (the wall-time bound of the lockstep ring). Returns the local shard
+  of exact causal attention in the same zigzag layout.
+  """
+  n = lax.axis_size(axis_name)
+  idx = lax.axis_index(axis_name)
+  tq2 = q.shape[1]
+  if tq2 % 2 != 0:
+    raise ValueError(f"zigzag local shard length must be even, got {tq2}")
+  t = tq2 // 2
+  d = q.shape[-1]
+  scale = (1.0 / math.sqrt(d)) if scale is None else scale
+  b, h = q.shape[0], q.shape[2]
+  z = 2 * n - 1  # stripe index of the latest stripe
+
+  # Split the local shard into its early (stripe idx) and late
+  # (stripe z-idx) halves; each accumulates independently.
+  q1, q2 = q[:, :t], q[:, t:]
+  acc1 = vary_like(
+      q, (jnp.full((b, h, t), _NEG, jnp.float32),
+          jnp.zeros((b, h, t), jnp.float32),
+          jnp.zeros((b, t, h, d), jnp.float32)),
+      default_axes=(axis_name,))
+  acc2 = tuple(jnp.copy(x) for x in acc1)
+
+  ar = jnp.arange(t)
+  kc, vc = k, v
+  perm = [(i, (i + 1) % n) for i in range(n)]
+  for step in range(n):
+    src = (idx - step) % n
+    k1, k2 = kc[:, :t], kc[:, t:]
+    v1, v2 = vc[:, :t], vc[:, t:]
+    # Stripe indices: q1 -> idx, q2 -> z-idx; kv1 -> src, kv2 -> z-src.
+    # q1 vs kv2 (z-src >= n > idx) is ALWAYS fully masked: skipped
+    # statically. q2 vs kv1 (z-idx >= n > src) is ALWAYS fully
+    # unmasked: runs mask-free. The two same-kind pairs gate on the
+    # device-varying stripe comparison (diagonal => triangular mask).
+    m1 = (idx * t + ar)[:, None] >= (src * t + ar)[None, :]
+    acc1 = lax.cond(
+        idx >= src,
+        lambda ops: _block_update(q1, k1, v1, *ops, scale,
+                                  m1[None, None]),
+        lambda ops: ops, acc1)
+    acc2 = _block_update(q2, k1, v1, *acc2, scale, None)
+    m2 = ((z - idx) * t + ar)[:, None] >= ((z - src) * t + ar)[None, :]
+    acc2 = lax.cond(
+        src >= idx,
+        lambda ops: _block_update(q2, k2, v2, *ops, scale,
+                                  m2[None, None]),
+        lambda ops: ops, acc2)
+    if step != n - 1:
+      kc = lax.ppermute(kc, axis_name, perm)
+      vc = lax.ppermute(vc, axis_name, perm)
+
+  def finish(acc):
+    m_, l_, o_ = acc
+    return o_ / jnp.maximum(l_, 1e-30).swapaxes(1, 2)[..., None]
+
+  out = jnp.concatenate([finish(acc1), finish(acc2)], axis=1)
+  return out.astype(q.dtype)
+
+
 def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
                         scale: Optional[float] = None):
   """Single-device flash-style attention: lax.scan over K/V blocks with
@@ -255,3 +352,33 @@ def make_sequence_parallel_attention(mesh: Mesh, impl: str = "ring",
   sharded = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                           out_specs=spec)
   return jax.jit(sharded)
+
+
+def make_zigzag_attention(mesh: Mesh, axis_name: str = SEQ_AXIS,
+                          scale: Optional[float] = None):
+  """Jitted load-balanced causal ring attention over GLOBAL (B, L, H,
+  D) arrays in NORMAL sequence order.
+
+  The zigzag permutation is applied (and inverted) inside the jit for
+  convenience -- XLA lowers it to a cross-shard gather, so pipelines
+  that can store their sequences pre-permuted (zigzag_order) should
+  call ring_attention_zigzag directly inside their own shard_map and
+  skip both gathers.
+  """
+  spec = P(None, axis_name, None, None)
+  n = mesh.shape[axis_name]
+
+  def body(q, k, v):
+    return ring_attention_zigzag(q, k, v, axis_name=axis_name,
+                                 scale=scale)
+
+  sharded = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec)
+
+  def call(q, k, v):
+    order = zigzag_order(q.shape[1], n)
+    inv = jnp.argsort(order)
+    qz, kz, vz = (jnp.take(x, order, axis=1) for x in (q, k, v))
+    return jnp.take(sharded(qz, kz, vz), inv, axis=1)
+
+  return jax.jit(call)

@@ -87,6 +87,45 @@ def test_bf16_inputs_accumulate_in_f32():
       rtol=2e-2, atol=2e-2)
 
 
+def test_zigzag_order_inverse_roundtrip():
+  order = np.asarray(sequence.zigzag_order(32, 8))
+  inv = np.asarray(sequence.zigzag_inverse(32, 8))
+  assert sorted(order) == list(range(32))
+  np.testing.assert_array_equal(order[inv], np.arange(32))
+  # Device 0's shard pairs the first and last stripes.
+  np.testing.assert_array_equal(order[:4], [0, 1, 30, 31])
+
+
+def test_zigzag_ring_matches_full_attention():
+  q, k, v = _qkv(l=32)
+  want = sequence.full_attention(q, k, v, causal=True)
+  fn = sequence.make_zigzag_attention(_mesh())
+  np.testing.assert_allclose(np.asarray(fn(q, k, v)), np.asarray(want),
+                             rtol=1e-5, atol=1e-5)
+
+
+def test_zigzag_ring_gradients_match_full_attention():
+  q, k, v = _qkv(l=32)
+  fn = sequence.make_zigzag_attention(_mesh())
+
+  def ref_loss(q, k, v):
+    return jnp.sum(sequence.full_attention(q, k, v, causal=True) ** 2)
+
+  def zz_loss(q, k, v):
+    return jnp.sum(fn(q, k, v) ** 2)
+
+  want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+  got = jax.grad(zz_loss, argnums=(0, 1, 2))(q, k, v)
+  for g, w in zip(got, want):
+    np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_zigzag_rejects_indivisible_length():
+  with pytest.raises(ValueError, match="not divisible"):
+    sequence.zigzag_order(30, 8)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_blockwise_attention_matches_full(causal):
   q, k, v = _qkv(l=64)
