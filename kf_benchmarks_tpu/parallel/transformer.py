@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kf_benchmarks_tpu.parallel import expert as ep_lib
 from kf_benchmarks_tpu.parallel import sequence as seq_lib
 from kf_benchmarks_tpu.parallel import tensor as tp_lib
 from kf_benchmarks_tpu.parallel.mesh import REPLICA_AXIS
@@ -41,47 +42,78 @@ TENSOR_AXIS = tp_lib.TENSOR_AXIS
 
 
 def init_params(key, *, vocab: int, d_model: int, n_layers: int,
-                n_heads: int, head_dim: int, d_ff: int,
-                max_len: int) -> Dict[str, Any]:
+                n_heads: int, head_dim: int, d_ff: int, max_len: int,
+                moe_every: int = 0, n_experts: int = 0) -> Dict[str, Any]:
   """Global (unsharded) parameter pytree; sharding comes from the
   in_specs of make_train_step, so the same tree drives both the
-  parallel step and the single-device reference."""
+  parallel step and the single-device reference.
+
+  moe_every > 0 replaces every moe_every-th block's dense MLP with a
+  Switch-MoE layer of n_experts experts (expert parallelism rides the
+  REPLICA axis -- experts are sharded where the tokens already are).
+  """
+  if moe_every and n_experts < 1:
+    raise ValueError(
+        f"moe_every={moe_every} needs n_experts >= 1, got {n_experts} "
+        f"(a zero-expert gate would only fail later inside switch_moe)")
   scale = 0.02
-  ks = iter(jax.random.split(key, 4 + 6 * n_layers))
+  ks = iter(jax.random.split(key, 4 + 8 * n_layers))
   params = {
       "embed": jax.random.normal(next(ks), (vocab, d_model)) * scale,
       "pos": jax.random.normal(next(ks), (max_len, d_model)) * scale,
       "ln_f": jnp.ones((d_model,)),
       "blocks": [],
   }
-  for _ in range(n_layers):
-    params["blocks"].append({
+  for i in range(n_layers):
+    block = {
         "ln1": jnp.ones((d_model,)),
         "wqkv": jax.random.normal(
             next(ks), (d_model, 3, n_heads, head_dim)) * scale,
         "wo": jax.random.normal(
             next(ks), (n_heads, head_dim, d_model)) * scale,
         "ln2": jnp.ones((d_model,)),
-        "w1": jax.random.normal(next(ks), (d_model, d_ff)) * scale,
-        "b1": jnp.zeros((d_ff,)),
-        "w2": jax.random.normal(next(ks), (d_ff, d_model)) * scale,
-        "b2": jnp.zeros((d_model,)),
-    })
+    }
+    if moe_every and (i + 1) % moe_every == 0:
+      block["gate_w"] = jax.random.normal(
+          next(ks), (d_model, n_experts)) * scale
+      block["ew1"] = jax.random.normal(
+          next(ks), (n_experts, d_model, d_ff)) * scale
+      block["eb1"] = jnp.zeros((n_experts, d_ff))
+      block["ew2"] = jax.random.normal(
+          next(ks), (n_experts, d_ff, d_model)) * scale
+      block["eb2"] = jnp.zeros((n_experts, d_model))
+    else:
+      block["w1"] = jax.random.normal(next(ks), (d_model, d_ff)) * scale
+      block["b1"] = jnp.zeros((d_ff,))
+      block["w2"] = jax.random.normal(next(ks), (d_ff, d_model)) * scale
+      block["b2"] = jnp.zeros((d_model,))
+    params["blocks"].append(block)
   return params
 
 
 def param_specs(params) -> Dict[str, Any]:
   """PartitionSpecs: tensor-sharded leaves on TENSOR_AXIS (heads for
-  attention, features for the MLP), everything else replicated."""
-  block = {
-      "ln1": P(), "ln2": P(),
-      "wqkv": P(None, None, TENSOR_AXIS),
-      "wo": P(TENSOR_AXIS),
+  attention, features for the dense MLP); MoE expert stacks sharded on
+  REPLICA_AXIS (the expert axis); everything else replicated."""
+  dense = {
       "w1": P(None, TENSOR_AXIS), "b1": P(TENSOR_AXIS),
       "w2": P(TENSOR_AXIS, None), "b2": P(),
   }
-  return {"embed": P(), "pos": P(), "ln_f": P(),
-          "blocks": [dict(block) for _ in params["blocks"]]}
+  moe = {
+      "gate_w": P(),
+      "ew1": P(REPLICA_AXIS), "eb1": P(REPLICA_AXIS),
+      "ew2": P(REPLICA_AXIS), "eb2": P(REPLICA_AXIS),
+  }
+  blocks = []
+  for bp in params["blocks"]:
+    spec = {
+        "ln1": P(), "ln2": P(),
+        "wqkv": P(None, None, TENSOR_AXIS),
+        "wo": P(TENSOR_AXIS),
+    }
+    spec.update(moe if "gate_w" in bp else dense)
+    blocks.append(spec)
+  return {"embed": P(), "pos": P(), "ln_f": P(), "blocks": blocks}
 
 
 def _rmsnorm(x, scale, eps=1e-6):
@@ -91,10 +123,16 @@ def _rmsnorm(x, scale, eps=1e-6):
 
 
 def forward_local(params, tokens, *, seq_axis=SEQ_AXIS,
-                  tensor_axis=TENSOR_AXIS):
-  """Per-shard forward: tokens (B_local, T_local) -> logits
-  (B_local, T_local, vocab). Runs inside a shard_map body; params are
-  the LOCAL shards (tensor-sharded leaves already sliced)."""
+                  tensor_axis=TENSOR_AXIS, expert_axis=REPLICA_AXIS,
+                  moe_capacity=None):
+  """Per-shard forward: tokens (B_local, T_local) -> (logits, moe_aux).
+
+  Runs inside a shard_map body; params are the LOCAL shards
+  (tensor-sharded leaves already sliced). MoE blocks (marked by a
+  'gate_w' leaf) dispatch over ``expert_axis`` -- the data axis, where
+  tokens are already sharded -- with per-shard capacity queues;
+  moe_capacity=None means capacity = local token count (no drops).
+  """
   b, t = tokens.shape
   global_t = t * lax.axis_size(seq_axis)
   max_len = params["pos"].shape[0]
@@ -108,6 +146,7 @@ def forward_local(params, tokens, *, seq_axis=SEQ_AXIS,
   x = params["embed"][tokens]
   pos0 = lax.axis_index(seq_axis) * t
   x = x + lax.dynamic_slice_in_dim(params["pos"], pos0, t, axis=0)
+  moe_aux = jnp.zeros((), jnp.float32)
   for lp in params["blocks"]:
     d_model = lp["wqkv"].shape[0]
     heads_local, head_dim = lp["wqkv"].shape[2], lp["wqkv"].shape[3]
@@ -123,17 +162,67 @@ def forward_local(params, tokens, *, seq_axis=SEQ_AXIS,
         lp["wo"].reshape(heads_local * head_dim, d_model),
         axis_name=tensor_axis)
     h = _rmsnorm(x, lp["ln2"])
-    x = x + tp_lib.parallel_mlp(h, lp["w1"], lp["b1"], lp["w2"],
-                                lp["b2"], axis_name=tensor_axis)
+    if "gate_w" in lp:
+      cap = (b * t) if moe_capacity is None else moe_capacity
+      y, aux = ep_lib.switch_moe(
+          h.reshape(b * t, d_model), lp["gate_w"], lp["ew1"],
+          lp["eb1"], lp["ew2"], lp["eb2"], capacity=cap,
+          axis_name=expert_axis)
+      x = x + y.reshape(b, t, d_model)
+      moe_aux = moe_aux + aux
+    else:
+      x = x + tp_lib.parallel_mlp(h, lp["w1"], lp["b1"], lp["w2"],
+                                  lp["b2"], axis_name=tensor_axis)
   x = _rmsnorm(x, params["ln_f"])
-  return jnp.einsum("btd,vd->btv", x, params["embed"].astype(jnp.float32))
+  logits = jnp.einsum("btd,vd->btv", x,
+                      params["embed"].astype(jnp.float32))
+  return logits, moe_aux
 
 
-def forward_reference(params, tokens):
+def _reference_moe(h, lp, groups, capacity):
+  """Dense (single-device) Switch-MoE with the SAME per-shard queue
+  semantics as the SPMD dispatch: tokens grouped as (replica, seq)
+  shards in row-major order, capacity per expert PER GROUP. jnp
+  throughout, so the oracle is differentiable."""
+  b, t, d = h.shape
+  nr, ns = groups
+  bl, tl = b // nr, t // ns
+  e_global = lp["gate_w"].shape[1]
+  out = jnp.zeros((b, t, d), h.dtype)
+  aux = jnp.zeros((), jnp.float32)
+  for r in range(nr):
+    for s in range(ns):
+      hg = h[r * bl:(r + 1) * bl, s * tl:(s + 1) * tl].reshape(
+          bl * tl, d).astype(jnp.float32)
+      probs = jax.nn.softmax(hg @ lp["gate_w"].astype(jnp.float32), -1)
+      idx = jnp.argmax(probs, -1)
+      assign = jax.nn.one_hot(idx, e_global, dtype=jnp.float32)
+      pos = jnp.cumsum(assign, axis=0) - 1.0
+      keep = assign * (pos < capacity)
+      gate = jnp.max(probs, -1)
+      hh = jax.nn.gelu(jnp.einsum("td,edf->tef", hg, lp["ew1"])
+                       + lp["eb1"])
+      y = jnp.einsum("tef,efd->ted", hh, lp["ew2"]) + lp["eb2"]
+      picked = jnp.einsum("te,ted->td", keep, y) * gate[:, None]
+      out = out.at[r * bl:(r + 1) * bl, s * tl:(s + 1) * tl].set(
+          picked.reshape(bl, tl, d).astype(h.dtype))
+      aux = aux + e_global * jnp.sum(
+          jnp.mean(assign, 0) * jnp.mean(probs, 0))
+  return out, aux / (nr * ns)
+
+
+def forward_reference(params, tokens, moe_groups=(1, 1),
+                      moe_capacity=None):
   """Single-device dense forward from the same GLOBAL params -- the
-  equivalence oracle (and the degenerate 1-device program)."""
+  equivalence oracle (and the degenerate 1-device program).
+
+  moe_groups = (n_replica, n_seq) of the mesh being mirrored: MoE
+  capacity queues are per data shard in the SPMD run, so the oracle
+  reproduces that grouping (irrelevant when capacity is never hit).
+  """
   b, t = tokens.shape
   x = params["embed"][tokens] + params["pos"][:t]
+  moe_aux = jnp.zeros((), jnp.float32)
   for lp in params["blocks"]:
     d_model = lp["wqkv"].shape[0]
     heads, head_dim = lp["wqkv"].shape[2], lp["wqkv"].shape[3]
@@ -145,9 +234,19 @@ def forward_reference(params, tokens):
     x = x + att.reshape(b, t, heads * head_dim) @ lp["wo"].reshape(
         heads * head_dim, d_model)
     h = _rmsnorm(x, lp["ln2"])
-    x = x + jax.nn.gelu(h @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"]
+    if "gate_w" in lp:
+      nr, ns = moe_groups
+      cap = ((b // nr) * (t // ns) if moe_capacity is None
+             else moe_capacity)
+      y, aux = _reference_moe(h, lp, moe_groups, cap)
+      x = x + y
+      moe_aux = moe_aux + aux
+    else:
+      x = x + jax.nn.gelu(h @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"]
   x = _rmsnorm(x, params["ln_f"])
-  return jnp.einsum("btd,vd->btv", x, params["embed"].astype(jnp.float32))
+  logits = jnp.einsum("btd,vd->btv", x,
+                      params["embed"].astype(jnp.float32))
+  return logits, moe_aux
 
 
 def _loss_from_logits(logits, labels):
@@ -156,8 +255,12 @@ def _loss_from_logits(logits, labels):
   return -jnp.mean(ll)
 
 
-def reference_loss(params, tokens, labels):
-  return _loss_from_logits(forward_reference(params, tokens), labels)
+def reference_loss(params, tokens, labels, moe_groups=(1, 1),
+                   moe_capacity=None, moe_aux_weight=0.01):
+  logits, aux = forward_reference(params, tokens,
+                                  moe_groups=moe_groups,
+                                  moe_capacity=moe_capacity)
+  return _loss_from_logits(logits, labels) + moe_aux_weight * aux
 
 
 def build_mesh(n_replica: int, n_seq: int, n_tensor: int,
@@ -171,18 +274,23 @@ def build_mesh(n_replica: int, n_seq: int, n_tensor: int,
   return Mesh(grid, (REPLICA_AXIS, SEQ_AXIS, TENSOR_AXIS))
 
 
-def make_train_step(mesh: Mesh, params_template, learning_rate: float):
+def make_train_step(mesh: Mesh, params_template, learning_rate: float,
+                    moe_capacity=None, moe_aux_weight: float = 0.01):
   """Jitted SGD train step over GLOBAL (params, tokens, labels):
   tokens/labels (batch, seq) sharded (replica, seq); params per
-  param_specs. Returns (new_params, loss)."""
+  param_specs. MoE blocks (if any in the template) add expert
+  parallelism over the replica axis and fold the Switch aux loss in at
+  ``moe_aux_weight``. Returns (new_params, loss)."""
   specs = param_specs(params_template)
   data_spec = P(REPLICA_AXIS, SEQ_AXIS)
   n_data = mesh.shape[REPLICA_AXIS] * mesh.shape[SEQ_AXIS]
 
   def body(params, tokens, labels):
     def local_loss(p):
-      logits = forward_local(p, tokens)
-      return _loss_from_logits(logits, labels)
+      logits, moe_aux = forward_local(p, tokens,
+                                      moe_capacity=moe_capacity)
+      return (_loss_from_logits(logits, labels)
+              + moe_aux_weight * moe_aux)
 
     loss, grads = jax.value_and_grad(local_loss)(params)
     # Token mean over the whole global batch: every shard holds the
