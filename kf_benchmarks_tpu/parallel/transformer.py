@@ -124,7 +124,7 @@ def _rmsnorm(x, scale, eps=1e-6):
 
 def forward_local(params, tokens, *, seq_axis=SEQ_AXIS,
                   tensor_axis=TENSOR_AXIS, expert_axis=REPLICA_AXIS,
-                  moe_capacity=None):
+                  moe_capacity=None, sp_layout: str = "contiguous"):
   """Per-shard forward: tokens (B_local, T_local) -> (logits, moe_aux).
 
   Runs inside a shard_map body; params are the LOCAL shards
@@ -132,6 +132,10 @@ def forward_local(params, tokens, *, seq_axis=SEQ_AXIS,
   'gate_w' leaf) dispatch over ``expert_axis`` -- the data axis, where
   tokens are already sharded -- with per-shard capacity queues;
   moe_capacity=None means capacity = local token count (no drops).
+
+  sp_layout='zigzag' expects the sequence axis sharded in
+  sequence.zigzag_order (stripe pair (idx, 2n-1-idx) per device) and
+  runs the load-balanced causal ring; positions follow the stripes.
   """
   b, t = tokens.shape
   global_t = t * lax.axis_size(seq_axis)
@@ -144,8 +148,16 @@ def forward_local(params, tokens, *, seq_axis=SEQ_AXIS,
         f"global sequence length {global_t} exceeds the positional "
         f"table max_len={max_len}")
   x = params["embed"][tokens]
-  pos0 = lax.axis_index(seq_axis) * t
-  x = x + lax.dynamic_slice_in_dim(params["pos"], pos0, t, axis=0)
+  if sp_layout == "zigzag":
+    stripe = t // 2
+    zidx = 2 * lax.axis_size(seq_axis) - 1 - lax.axis_index(seq_axis)
+    ar = jnp.arange(stripe)
+    pos_idx = jnp.concatenate(
+        [lax.axis_index(seq_axis) * stripe + ar, zidx * stripe + ar])
+    x = x + jnp.take(params["pos"], pos_idx, axis=0)
+  else:
+    pos0 = lax.axis_index(seq_axis) * t
+    x = x + lax.dynamic_slice_in_dim(params["pos"], pos0, t, axis=0)
   moe_aux = jnp.zeros((), jnp.float32)
   for lp in params["blocks"]:
     d_model = lp["wqkv"].shape[0]
@@ -154,9 +166,13 @@ def forward_local(params, tokens, *, seq_axis=SEQ_AXIS,
     qkv = tp_lib.column_parallel_dense(
         h, lp["wqkv"].reshape(d_model, 3 * heads_local * head_dim))
     qkv = qkv.reshape(b, t, 3, heads_local, head_dim)
-    att = seq_lib.ring_attention(
-        qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
-        axis_name=seq_axis, causal=True)
+    if sp_layout == "zigzag":
+      att = seq_lib.ring_attention_zigzag(
+          qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], axis_name=seq_axis)
+    else:
+      att = seq_lib.ring_attention(
+          qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+          axis_name=seq_axis, causal=True)
     x = x + tp_lib.row_parallel_dense(
         att.reshape(b, t, heads_local * head_dim),
         lp["wo"].reshape(heads_local * head_dim, d_model),
@@ -275,20 +291,37 @@ def build_mesh(n_replica: int, n_seq: int, n_tensor: int,
 
 
 def make_train_step(mesh: Mesh, params_template, learning_rate: float,
-                    moe_capacity=None, moe_aux_weight: float = 0.01):
+                    moe_capacity=None, moe_aux_weight: float = 0.01,
+                    sp_layout: str = "contiguous"):
   """Jitted SGD train step over GLOBAL (params, tokens, labels):
-  tokens/labels (batch, seq) sharded (replica, seq); params per
-  param_specs. MoE blocks (if any in the template) add expert
-  parallelism over the replica axis and fold the Switch aux loss in at
-  ``moe_aux_weight``. Returns (new_params, loss)."""
+  tokens/labels (batch, seq) in NORMAL order, sharded (replica, seq);
+  params per param_specs. MoE blocks (if any in the template) add
+  expert parallelism over the replica axis and fold the Switch aux
+  loss in at ``moe_aux_weight``. sp_layout='zigzag' permutes the data
+  into sequence.zigzag_order at the jit boundary and runs the
+  load-balanced causal ring (input pipelines that store sequences
+  pre-permuted should shard_map forward_local directly). Returns
+  (new_params, loss) -- the token-mean loss is permutation-invariant,
+  so the layout never leaks to the caller."""
+  if sp_layout not in ("contiguous", "zigzag"):
+    raise ValueError(f"unknown sp_layout {sp_layout!r}")
+  if sp_layout == "zigzag" and any(
+      "gate_w" in bp for bp in params_template["blocks"]):
+    # The MoE capacity queues are ordered by token position within the
+    # shard; the zigzag permutation changes that grouping and no
+    # oracle pins it yet. Refuse rather than run untested semantics.
+    raise ValueError("sp_layout='zigzag' with MoE blocks is not "
+                     "supported yet")
   specs = param_specs(params_template)
   data_spec = P(REPLICA_AXIS, SEQ_AXIS)
   n_data = mesh.shape[REPLICA_AXIS] * mesh.shape[SEQ_AXIS]
+  n_seq = mesh.shape[SEQ_AXIS]
 
   def body(params, tokens, labels):
     def local_loss(p):
       logits, moe_aux = forward_local(p, tokens,
-                                      moe_capacity=moe_capacity)
+                                      moe_capacity=moe_capacity,
+                                      sp_layout=sp_layout)
       return (_loss_from_logits(logits, labels)
               + moe_aux_weight * moe_aux)
 
@@ -312,4 +345,12 @@ def make_train_step(mesh: Mesh, params_template, learning_rate: float,
       body, mesh=mesh,
       in_specs=(specs, data_spec, data_spec),
       out_specs=(specs, P()))
-  return jax.jit(sharded, donate_argnums=(0,))
+  if sp_layout == "contiguous":
+    return jax.jit(sharded, donate_argnums=(0,))
+
+  def call(params, tokens, labels):
+    order = seq_lib.zigzag_order(tokens.shape[1], n_seq)
+    return sharded(params, jnp.take(tokens, order, axis=1),
+                   jnp.take(labels, order, axis=1))
+
+  return jax.jit(call, donate_argnums=(0,))

@@ -129,6 +129,37 @@ def test_moe_composes_with_all_axes():
   assert np.isfinite(last) and last < first, (first, last)
 
 
+@pytest.mark.parametrize("mesh_shape", [(1, 4, 1), (2, 2, 2)])
+def test_zigzag_layout_matches_single_device(mesh_shape):
+  # The load-balanced sp layout is a pure relabeling of which device
+  # holds which token: loss AND trained params must equal the
+  # normal-order single-device reference exactly.
+  params, tokens, labels = _setup(seed=21)
+  mesh = transformer.build_mesh(*mesh_shape)
+  step = transformer.make_train_step(mesh, params, learning_rate=0.1,
+                                     sp_layout="zigzag")
+  want_loss, ref_grads = jax.value_and_grad(
+      transformer.reference_loss)(params, tokens, labels)
+  ref_new = jax.tree.map(lambda p, g: p - 0.1 * g, params, ref_grads)
+  got_new, got_loss = step(jax.tree.map(jnp.copy, params), tokens,
+                           labels)
+  np.testing.assert_allclose(float(got_loss), float(want_loss),
+                             rtol=1e-5, atol=1e-6)
+  for got, want in zip(jax.tree.leaves(got_new),
+                       jax.tree.leaves(ref_new)):
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_zigzag_layout_rejects_moe():
+  params = transformer.init_params(
+      jax.random.PRNGKey(22), moe_every=2, n_experts=4, **CFG)
+  mesh = transformer.build_mesh(2, 2, 1)
+  with pytest.raises(ValueError, match="zigzag.*MoE"):
+    transformer.make_train_step(mesh, params, learning_rate=0.1,
+                                sp_layout="zigzag")
+
+
 def test_alternate_mesh_shapes():
   # Degenerate axes must work too: pure-sp (1, 8, 1) and pure-tp
   # (1, 1, 4) meshes run the same program.
