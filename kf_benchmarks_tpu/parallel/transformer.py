@@ -195,11 +195,18 @@ def forward_local(params, tokens, *, seq_axis=SEQ_AXIS,
   return logits, moe_aux
 
 
-def _reference_moe(h, lp, groups, capacity):
+def _reference_moe(h, lp, groups, capacity, layout="contiguous"):
   """Dense (single-device) Switch-MoE with the SAME per-shard queue
   semantics as the SPMD dispatch: tokens grouped as (replica, seq)
   shards in row-major order, capacity per expert PER GROUP. jnp
-  throughout, so the oracle is differentiable."""
+  throughout, so the oracle is differentiable.
+
+  layout='zigzag' mirrors sp_layout='zigzag': seq shard s holds the
+  stripe pair (s, 2*ns-1-s), in that in-shard order, so the capacity
+  queues fill exactly as on the SPMD devices.
+  """
+  if layout not in ("contiguous", "zigzag"):
+    raise ValueError(f"unknown moe layout {layout!r}")
   b, t, d = h.shape
   nr, ns = groups
   bl, tl = b // nr, t // ns
@@ -208,7 +215,12 @@ def _reference_moe(h, lp, groups, capacity):
   aux = jnp.zeros((), jnp.float32)
   for r in range(nr):
     for s in range(ns):
-      hg = h[r * bl:(r + 1) * bl, s * tl:(s + 1) * tl].reshape(
+      if layout == "zigzag":
+        # Shard s of the SAME permutation the SPMD data path applies.
+        cols = seq_lib.zigzag_order(t, ns).reshape(ns, tl)[s]
+      else:
+        cols = jnp.arange(s * tl, (s + 1) * tl)
+      hg = h[r * bl:(r + 1) * bl, cols].reshape(
           bl * tl, d).astype(jnp.float32)
       probs = jax.nn.softmax(hg @ lp["gate_w"].astype(jnp.float32), -1)
       idx = jnp.argmax(probs, -1)
@@ -220,7 +232,7 @@ def _reference_moe(h, lp, groups, capacity):
                        + lp["eb1"])
       y = jnp.einsum("tef,efd->ted", hh, lp["ew2"]) + lp["eb2"]
       picked = jnp.einsum("te,ted->td", keep, y) * gate[:, None]
-      out = out.at[r * bl:(r + 1) * bl, s * tl:(s + 1) * tl].set(
+      out = out.at[r * bl:(r + 1) * bl, cols].set(
           picked.reshape(bl, tl, d).astype(h.dtype))
       aux = aux + e_global * jnp.sum(
           jnp.mean(assign, 0) * jnp.mean(probs, 0))
@@ -228,7 +240,7 @@ def _reference_moe(h, lp, groups, capacity):
 
 
 def forward_reference(params, tokens, moe_groups=(1, 1),
-                      moe_capacity=None):
+                      moe_capacity=None, moe_layout="contiguous"):
   """Single-device dense forward from the same GLOBAL params -- the
   equivalence oracle (and the degenerate 1-device program).
 
@@ -254,7 +266,8 @@ def forward_reference(params, tokens, moe_groups=(1, 1),
       nr, ns = moe_groups
       cap = ((b // nr) * (t // ns) if moe_capacity is None
              else moe_capacity)
-      y, aux = _reference_moe(h, lp, moe_groups, cap)
+      y, aux = _reference_moe(h, lp, moe_groups, cap,
+                              layout=moe_layout)
       x = x + y
       moe_aux = moe_aux + aux
     else:
@@ -272,10 +285,12 @@ def _loss_from_logits(logits, labels):
 
 
 def reference_loss(params, tokens, labels, moe_groups=(1, 1),
-                   moe_capacity=None, moe_aux_weight=0.01):
+                   moe_capacity=None, moe_aux_weight=0.01,
+                   moe_layout="contiguous"):
   logits, aux = forward_reference(params, tokens,
                                   moe_groups=moe_groups,
-                                  moe_capacity=moe_capacity)
+                                  moe_capacity=moe_capacity,
+                                  moe_layout=moe_layout)
   return _loss_from_logits(logits, labels) + moe_aux_weight * aux
 
 
@@ -305,13 +320,6 @@ def make_train_step(mesh: Mesh, params_template, learning_rate: float,
   so the layout never leaks to the caller."""
   if sp_layout not in ("contiguous", "zigzag"):
     raise ValueError(f"unknown sp_layout {sp_layout!r}")
-  if sp_layout == "zigzag" and any(
-      "gate_w" in bp for bp in params_template["blocks"]):
-    # The MoE capacity queues are ordered by token position within the
-    # shard; the zigzag permutation changes that grouping and no
-    # oracle pins it yet. Refuse rather than run untested semantics.
-    raise ValueError("sp_layout='zigzag' with MoE blocks is not "
-                     "supported yet")
   specs = param_specs(params_template)
   data_spec = P(REPLICA_AXIS, SEQ_AXIS)
   n_data = mesh.shape[REPLICA_AXIS] * mesh.shape[SEQ_AXIS]

@@ -74,29 +74,27 @@ def test_rejects_sequence_longer_than_max_len():
     step(jax.tree.map(jnp.copy, params), tokens, labels)
 
 
-@pytest.mark.parametrize("mesh_shape,caps", [
-    ((4, 1, 1), (None, 2)),   # dp x ep, incl. capacity drops
-    ((2, 2, 1), (None,)),     # ep composed with the seq axis
-    ((2, 2, 2), (None,)),     # ep composed with seq AND tensor axes
-])
-def test_moe_blocks_match_single_device(mesh_shape, caps):
-  # Experts shard over the replica axis; loss AND a trained step match
-  # the grouped single-device oracle (including capacity queues), on
-  # every mesh shape the expert axis must compose with.
+def _assert_moe_step_matches_oracle(mesh_shape, caps, sp_layout,
+                                    batch, seed):
+  """One SGD step of the MoE transformer vs the grouped oracle: loss
+  AND trained params, for each capacity in ``caps``."""
   params = transformer.init_params(
-      jax.random.PRNGKey(11), moe_every=2, n_experts=8, **CFG)
-  tokens = jax.random.randint(jax.random.PRNGKey(12), (8, 16), 0,
-                              CFG["vocab"])
+      jax.random.PRNGKey(seed), moe_every=2, n_experts=8, **CFG)
+  tokens = jax.random.randint(jax.random.PRNGKey(seed + 1),
+                              (batch, 16), 0, CFG["vocab"])
   labels = jnp.roll(tokens, -1, axis=1)
   mesh = transformer.build_mesh(*mesh_shape)
   moe_groups = (mesh_shape[0], mesh_shape[1])
+  moe_layout = "zigzag" if sp_layout == "zigzag" else "contiguous"
   for cap in caps:
     step = transformer.make_train_step(mesh, params, learning_rate=0.1,
-                                       moe_capacity=cap)
+                                       moe_capacity=cap,
+                                       sp_layout=sp_layout)
     want_loss, ref_grads = jax.value_and_grad(
         transformer.reference_loss)(params, tokens, labels,
                                     moe_groups=moe_groups,
-                                    moe_capacity=cap)
+                                    moe_capacity=cap,
+                                    moe_layout=moe_layout)
     ref_new = jax.tree.map(lambda p, g: p - 0.1 * g, params, ref_grads)
     got_new, got_loss = step(jax.tree.map(jnp.copy, params), tokens,
                              labels)
@@ -107,6 +105,20 @@ def test_moe_blocks_match_single_device(mesh_shape, caps):
       np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                  rtol=1e-4, atol=1e-5,
                                  err_msg=f"cap={cap}")
+
+
+@pytest.mark.parametrize("mesh_shape,caps", [
+    ((4, 1, 1), (None, 2)),   # dp x ep, incl. capacity drops
+    ((2, 2, 1), (None,)),     # ep composed with the seq axis
+    ((2, 2, 2), (None,)),     # ep composed with seq AND tensor axes
+])
+def test_moe_blocks_match_single_device(mesh_shape, caps):
+  # Experts shard over the replica axis; loss AND a trained step match
+  # the grouped single-device oracle (including capacity queues), on
+  # every mesh shape the expert axis must compose with.
+  _assert_moe_step_matches_oracle(mesh_shape, caps,
+                                  sp_layout="contiguous", batch=8,
+                                  seed=11)
 
 
 def test_moe_composes_with_all_axes():
@@ -151,13 +163,12 @@ def test_zigzag_layout_matches_single_device(mesh_shape):
                                rtol=1e-4, atol=1e-5)
 
 
-def test_zigzag_layout_rejects_moe():
-  params = transformer.init_params(
-      jax.random.PRNGKey(22), moe_every=2, n_experts=4, **CFG)
-  mesh = transformer.build_mesh(2, 2, 1)
-  with pytest.raises(ValueError, match="zigzag.*MoE"):
-    transformer.make_train_step(mesh, params, learning_rate=0.1,
-                                sp_layout="zigzag")
+def test_zigzag_layout_with_moe_matches_single_device():
+  # zigzag sp layout + MoE: the capacity queues fill in the zigzag
+  # in-shard token order; the oracle mirrors that grouping exactly
+  # (moe_layout='zigzag'), including with a tight capacity.
+  _assert_moe_step_matches_oracle((2, 2, 1), (None, 3),
+                                  sp_layout="zigzag", batch=4, seed=22)
 
 
 def test_alternate_mesh_shapes():
