@@ -24,6 +24,13 @@ Both are differentiable (ppermute/all_to_all have transpose rules, the
 online softmax is plain jnp), accumulate in float32 regardless of input
 dtype, and match ``full_attention`` to numerical tolerance -- pinned by
 tests/test_sequence_parallel.py on the 8-device virtual mesh.
+
+Memory: every block update runs under ``jax.checkpoint``
+(flash-style recompute-in-backward), so the blockwise bound holds for
+TRAINING too -- autodiff recomputes the per-block score/probability
+tensors instead of saving them as residuals; what the backward pass
+stores per step is the O(block) carry/operand set, not the score tile
+(pinned by test_blockwise_grad_memory_is_blockwise).
 """
 
 from __future__ import annotations
@@ -101,6 +108,40 @@ def _block_update(q, k, v, m, l, o, scale, mask):
   return m_new, l_new, o_new
 
 
+def _block_update_remat(q, k, v, m, l, o, scale, offsets=None,
+                        prevent_cse=True):
+  """``_block_update`` with recompute-in-backward (flash-style remat).
+
+  Without this, autodiff saves the (.., Tq, Tk) score/probability
+  tensors of EVERY block step as residuals -- ~5 full score-tensor
+  copies across a scan/ring, erasing the blockwise memory win exactly
+  when it matters (training). jax.checkpoint drops those residuals and
+  recomputes the block matmuls in the backward pass; what remains per
+  step is the O(Tq + Tk) carry/operand set.
+
+  ``offsets`` is None (no mask) or the scalar (q_off, k_off) GLOBAL
+  position offsets of the two blocks; the causal mask is rebuilt
+  INSIDE the checkpointed region from them, so the per-step residual
+  is two scalars -- passing a materialised (Tq, Tk) mask as an operand
+  would make checkpoint save it, stacking an O(L^2) bool residual
+  across the scan/ring. ``prevent_cse=False`` is for lax.scan bodies,
+  where scan already prevents the problematic CSE (per the
+  jax.checkpoint docs) and the default would only wall off fusion.
+  """
+  def inner(q_, k_, v_, m_, l_, o_, off):
+    if off is None:
+      mask = None
+    else:
+      q_off, k_off = off
+      qpos = q_off + jnp.arange(q_.shape[1])
+      kpos = k_off + jnp.arange(k_.shape[1])
+      mask = (qpos[:, None] >= kpos[None, :])[None, None]
+    return _block_update(q_, k_, v_, m_, l_, o_, scale, mask)
+
+  return jax.checkpoint(inner, prevent_cse=prevent_cse)(
+      q, k, v, m, l, o, offsets)
+
+
 def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
                    causal: bool = False, scale: Optional[float] = None):
   """Blockwise ring attention inside a shard_map body.
@@ -137,9 +178,6 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
     # device (idx - step) mod n; global key positions follow it.
     if causal:
       src = (idx - step) % n
-      qpos = idx * tq + jnp.arange(tq)
-      kpos = src * tk + jnp.arange(tk)
-      mask = (qpos[:, None] >= kpos[None, :])[None, None]
       # A block strictly in this device's future (src > idx) is fully
       # masked; skip its matmuls entirely. The predicate is per-device,
       # so the conditional runs the update only where work exists --
@@ -148,11 +186,12 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
       # balance the skip across devices; future optimisation.)
       m, l, o = lax.cond(
           src <= idx,
-          lambda ops: _block_update(*ops, scale, mask),
+          lambda ops: _block_update_remat(*ops, scale,
+                                          (idx * tq, src * tk)),
           lambda ops: (ops[3], ops[4], ops[5]),
           (q, kc, vc, m, l, o))
     else:
-      m, l, o = _block_update(q, kc, vc, m, l, o, scale, None)
+      m, l, o = _block_update_remat(q, kc, vc, m, l, o, scale, None)
     if step != n - 1:
       kc = lax.ppermute(kc, axis_name, perm)
       vc = lax.ppermute(vc, axis_name, perm)
@@ -233,18 +272,16 @@ def ring_attention_zigzag(q, k, v, axis_name: str = SEQ_AXIS,
     # statically. q2 vs kv1 (z-idx >= n > src) is ALWAYS fully
     # unmasked: runs mask-free. The two same-kind pairs gate on the
     # device-varying stripe comparison (diagonal => triangular mask).
-    m1 = (idx * t + ar)[:, None] >= (src * t + ar)[None, :]
     acc1 = lax.cond(
         idx >= src,
-        lambda ops: _block_update(q1, k1, v1, *ops, scale,
-                                  m1[None, None]),
+        lambda ops: _block_update_remat(q1, k1, v1, *ops, scale,
+                                        (idx * t, src * t)),
         lambda ops: ops, acc1)
-    acc2 = _block_update(q2, k1, v1, *acc2, scale, None)
-    m2 = ((z - idx) * t + ar)[:, None] >= ((z - src) * t + ar)[None, :]
+    acc2 = _block_update_remat(q2, k1, v1, *acc2, scale, None)
     acc2 = lax.cond(
         src >= idx,
-        lambda ops: _block_update(q2, k2, v2, *ops, scale,
-                                  m2[None, None]),
+        lambda ops: _block_update_remat(q2, k2, v2, *ops, scale,
+                                        ((z - idx) * t, (z - src) * t)),
         lambda ops: ops, acc2)
     if step != n - 1:
       kc = lax.ppermute(kc, axis_name, perm)
@@ -261,9 +298,14 @@ def ring_attention_zigzag(q, k, v, axis_name: str = SEQ_AXIS,
 def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
                         scale: Optional[float] = None):
   """Single-device flash-style attention: lax.scan over K/V blocks with
-  the same online softmax as the ring schedule, so peak memory is
-  O(L * block) instead of O(L^2) and long contexts fit in HBM on one
+  the same online softmax as the ring schedule, so forward peak memory
+  is O(L * block) instead of O(L^2) and long contexts fit in HBM on one
   chip. Exact (not windowed): every query still attends to every key.
+  The scan body is rematerialised (``_block_update_remat``), so the
+  backward pass recomputes each block's scores rather than stacking
+  nblk full-score residuals; its stored state is the scan carry stack,
+  O(L^2 * D / block) -- ~5*block/D x smaller than unrematerialised
+  residuals (block=512, D=64: ~40x).
 
   (B, L, H, D) -> (B, L, H, D); L % block_size == 0. Composes with
   ring_attention -- inside a ring step each device could scan its local
@@ -284,17 +326,13 @@ def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
       (jnp.full((b, h, l), _NEG, jnp.float32),
        jnp.zeros((b, h, l), jnp.float32),
        jnp.zeros((b, l, h, d), jnp.float32)))
-  qpos = jnp.arange(l)
 
   def step(carry, inp):
     m, acc_l, o = carry
     j, kj, vj = inp
-    if causal:
-      kpos = j * block_size + jnp.arange(block_size)
-      mask = (qpos[:, None] >= kpos[None, :])[None, None]
-    else:
-      mask = None
-    m, acc_l, o = _block_update(q, kj, vj, m, acc_l, o, scale_, mask)
+    offsets = (0, j * block_size) if causal else None
+    m, acc_l, o = _block_update_remat(q, kj, vj, m, acc_l, o, scale_,
+                                      offsets, prevent_cse=False)
     return (m, acc_l, o), None
 
   (m, acc_l, o), _ = lax.scan(

@@ -180,3 +180,49 @@ def test_ring_score_memory_is_blockwise():
   assert peak_bytes < full_score_bytes // 4, (
       f"peak temp {peak_bytes} is within 4x of the full (L,L) score "
       f"tensor ({full_score_bytes}); the schedule is not blockwise")
+
+
+def test_blockwise_grad_memory_is_blockwise():
+  # The ADVICE round-4 finding: without remat, autodiff saves ~5 full
+  # (L, L)-score-sized residual stacks across the scan, so TRAINING
+  # memory was worse than plain attention. With _block_update_remat the
+  # backward pass recomputes block scores; the grad program's peak temp
+  # must stay well under one full score tensor, let alone five.
+  b, l, h, d = 1, 512, 2, 8
+  q, k, v = _qkv(b=b, l=l, h=h, d=d)
+
+  def loss(q, k, v):
+    return jnp.sum(sequence.blockwise_attention(
+        q, k, v, block_size=64, causal=True) ** 2)
+
+  compiled = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(
+      q, k, v).compile()
+  peak_bytes = compiled.memory_analysis().temp_size_in_bytes
+  full_score_bytes = 4 * b * h * l * l
+  assert peak_bytes < full_score_bytes, (
+      f"grad peak temp {peak_bytes} >= one full (L,L) score tensor "
+      f"({full_score_bytes}); backward residuals are not blockwise")
+
+
+def test_ring_grad_memory_is_blockwise():
+  # Same property for the ring schedule: backward residuals per ring
+  # step are the travelling K/V operands and carries, never the
+  # (Lq_local, L_global) score stack the unrematerialised loop held.
+  b, l, h, d = 1, 512, 2, 8
+  q, k, v = _qkv(b=b, l=l, h=h, d=d)
+  mesh = _mesh()
+  spec = P(None, sequence.SEQ_AXIS, None, None)
+  body = jax.shard_map(
+      lambda q, k, v: sequence.ring_attention(q, k, v, causal=True),
+      mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+
+  def loss(q, k, v):
+    return jnp.sum(body(q, k, v) ** 2)
+
+  compiled = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(
+      q, k, v).compile()
+  peak_bytes = compiled.memory_analysis().temp_size_in_bytes
+  full_score_bytes = 4 * b * h * l * l
+  assert peak_bytes < full_score_bytes, (
+      f"ring grad peak temp {peak_bytes} >= one full (L,L) score "
+      f"tensor ({full_score_bytes}); backward residuals not blockwise")
