@@ -47,12 +47,20 @@ def spmd_pipeline(stage_fn: Callable, params_local, x,
   mbatches = x.reshape((m, mb) + x.shape[1:])
   # Both carries become device-varying inside the loop (ppermute /
   # axis_index-dependent updates); mark the zero-initialised values
-  # varying up front so the scan carry types line up.
-  out_accum = lax.pcast(jnp.zeros_like(mbatches), (axis_name,),
-                        to="varying")
+  # varying up front so the scan carry types line up. Under a COMPOSED
+  # mesh (dp x pp x sp x ...) the input already varies on the data
+  # axes, so the carries must carry that whole set plus the stage axis.
+  want = set(getattr(x.aval, "vma", ())) | {axis_name}
+
+  def _vary(z):
+    # pcast rejects axes the value already varies on (zeros_like keeps
+    # the source's vma), so cast only the missing ones.
+    missing = tuple(sorted(want - set(getattr(z.aval, "vma", ()))))
+    return lax.pcast(z, missing, to="varying") if missing else z
+
+  out_accum = _vary(jnp.zeros_like(mbatches))
   # The inter-stage register travelling the pipeline.
-  state = lax.pcast(jnp.zeros((mb,) + x.shape[1:], x.dtype),
-                    (axis_name,), to="varying")
+  state = _vary(jnp.zeros((mb,) + x.shape[1:], x.dtype))
 
   shift = [(i, i + 1) for i in range(s - 1)]  # non-cyclic: stage i -> i+1
 

@@ -1,4 +1,6 @@
-"""Composed 3-D parallelism: a decoder-only LM trained over dp x sp x tp.
+"""Composed parallelism: a decoder-only LM trained over dp x sp x tp
+(x ep via Switch-MoE blocks), or dp x pp x sp x tp with the layer
+stack sharded over the GPipe stage axis (make_pipelined_train_step).
 
 Beyond-reference capability, and the composition proof for the
 parallel/ primitives: one shard_map training step over a
@@ -33,6 +35,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from kf_benchmarks_tpu.parallel import expert as ep_lib
+from kf_benchmarks_tpu.parallel import pipeline as pp_lib
 from kf_benchmarks_tpu.parallel import sequence as seq_lib
 from kf_benchmarks_tpu.parallel import tensor as tp_lib
 from kf_benchmarks_tpu.parallel.mesh import REPLICA_AXIS
@@ -122,21 +125,10 @@ def _rmsnorm(x, scale, eps=1e-6):
           ) * scale
 
 
-def forward_local(params, tokens, *, seq_axis=SEQ_AXIS,
-                  tensor_axis=TENSOR_AXIS, expert_axis=REPLICA_AXIS,
-                  moe_capacity=None, sp_layout: str = "contiguous"):
-  """Per-shard forward: tokens (B_local, T_local) -> (logits, moe_aux).
-
-  Runs inside a shard_map body; params are the LOCAL shards
-  (tensor-sharded leaves already sliced). MoE blocks (marked by a
-  'gate_w' leaf) dispatch over ``expert_axis`` -- the data axis, where
-  tokens are already sharded -- with per-shard capacity queues;
-  moe_capacity=None means capacity = local token count (no drops).
-
-  sp_layout='zigzag' expects the sequence axis sharded in
-  sequence.zigzag_order (stripe pair (idx, 2n-1-idx) per device) and
-  runs the load-balanced causal ring; positions follow the stripes.
-  """
+def _embed_positions(params, tokens, *, seq_axis, sp_layout):
+  """Token + positional embedding of the LOCAL (B, T_local) shard;
+  positions follow the shard's GLOBAL offsets (stripe pair offsets
+  under the zigzag layout)."""
   b, t = tokens.shape
   global_t = t * lax.axis_size(seq_axis)
   max_len = params["pos"].shape[0]
@@ -154,30 +146,63 @@ def forward_local(params, tokens, *, seq_axis=SEQ_AXIS,
     ar = jnp.arange(stripe)
     pos_idx = jnp.concatenate(
         [lax.axis_index(seq_axis) * stripe + ar, zidx * stripe + ar])
-    x = x + jnp.take(params["pos"], pos_idx, axis=0)
+    return x + jnp.take(params["pos"], pos_idx, axis=0)
+  pos0 = lax.axis_index(seq_axis) * t
+  return x + lax.dynamic_slice_in_dim(params["pos"], pos0, t, axis=0)
+
+
+def _attention_residual(lp, x, *, seq_axis, tensor_axis, sp_layout):
+  """ln -> qkv -> (ring|zigzag) attention -> output proj residual.
+
+  Returns (x_new, h) where h is the post-attention rmsnorm the MLP/MoE
+  half of the block consumes -- shared by the flat and the pipelined
+  forward paths.
+  """
+  b, t, _ = x.shape
+  d_model = lp["wqkv"].shape[0]
+  heads_local, head_dim = lp["wqkv"].shape[2], lp["wqkv"].shape[3]
+  h = _rmsnorm(x, lp["ln1"])
+  qkv = tp_lib.column_parallel_dense(
+      h, lp["wqkv"].reshape(d_model, 3 * heads_local * head_dim))
+  qkv = qkv.reshape(b, t, 3, heads_local, head_dim)
+  if sp_layout == "zigzag":
+    att = seq_lib.ring_attention_zigzag(
+        qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], axis_name=seq_axis)
   else:
-    pos0 = lax.axis_index(seq_axis) * t
-    x = x + lax.dynamic_slice_in_dim(params["pos"], pos0, t, axis=0)
+    att = seq_lib.ring_attention(
+        qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+        axis_name=seq_axis, causal=True)
+  x = x + tp_lib.row_parallel_dense(
+      att.reshape(b, t, heads_local * head_dim),
+      lp["wo"].reshape(heads_local * head_dim, d_model),
+      axis_name=tensor_axis)
+  return x, _rmsnorm(x, lp["ln2"])
+
+
+def forward_local(params, tokens, *, seq_axis=SEQ_AXIS,
+                  tensor_axis=TENSOR_AXIS, expert_axis=REPLICA_AXIS,
+                  moe_capacity=None, sp_layout: str = "contiguous"):
+  """Per-shard forward: tokens (B_local, T_local) -> (logits, moe_aux).
+
+  Runs inside a shard_map body; params are the LOCAL shards
+  (tensor-sharded leaves already sliced). MoE blocks (marked by a
+  'gate_w' leaf) dispatch over ``expert_axis`` -- the data axis, where
+  tokens are already sharded -- with per-shard capacity queues;
+  moe_capacity=None means capacity = local token count (no drops).
+
+  sp_layout='zigzag' expects the sequence axis sharded in
+  sequence.zigzag_order (stripe pair (idx, 2n-1-idx) per device) and
+  runs the load-balanced causal ring; positions follow the stripes.
+  """
+  b, t = tokens.shape
+  x = _embed_positions(params, tokens, seq_axis=seq_axis,
+                       sp_layout=sp_layout)
   moe_aux = jnp.zeros((), jnp.float32)
   for lp in params["blocks"]:
     d_model = lp["wqkv"].shape[0]
-    heads_local, head_dim = lp["wqkv"].shape[2], lp["wqkv"].shape[3]
-    h = _rmsnorm(x, lp["ln1"])
-    qkv = tp_lib.column_parallel_dense(
-        h, lp["wqkv"].reshape(d_model, 3 * heads_local * head_dim))
-    qkv = qkv.reshape(b, t, 3, heads_local, head_dim)
-    if sp_layout == "zigzag":
-      att = seq_lib.ring_attention_zigzag(
-          qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], axis_name=seq_axis)
-    else:
-      att = seq_lib.ring_attention(
-          qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
-          axis_name=seq_axis, causal=True)
-    x = x + tp_lib.row_parallel_dense(
-        att.reshape(b, t, heads_local * head_dim),
-        lp["wo"].reshape(heads_local * head_dim, d_model),
-        axis_name=tensor_axis)
-    h = _rmsnorm(x, lp["ln2"])
+    x, h = _attention_residual(lp, x, seq_axis=seq_axis,
+                               tensor_axis=tensor_axis,
+                               sp_layout=sp_layout)
     if "gate_w" in lp:
       cap = (b * t) if moe_capacity is None else moe_capacity
       y, aux = ep_lib.switch_moe(
@@ -294,15 +319,19 @@ def reference_loss(params, tokens, labels, moe_groups=(1, 1),
   return _loss_from_logits(logits, labels) + moe_aux_weight * aux
 
 
-def build_mesh(n_replica: int, n_seq: int, n_tensor: int,
-               devices=None) -> Mesh:
+def _grid_mesh(sizes, axis_names, devices=None) -> Mesh:
   import numpy as np
   devices = devices if devices is not None else jax.devices()
-  need = n_replica * n_seq * n_tensor
+  need = math.prod(sizes)
   if len(devices) < need:
     raise ValueError(f"need {need} devices, have {len(devices)}")
-  grid = np.array(devices[:need]).reshape(n_replica, n_seq, n_tensor)
-  return Mesh(grid, (REPLICA_AXIS, SEQ_AXIS, TENSOR_AXIS))
+  return Mesh(np.array(devices[:need]).reshape(sizes), axis_names)
+
+
+def build_mesh(n_replica: int, n_seq: int, n_tensor: int,
+               devices=None) -> Mesh:
+  return _grid_mesh((n_replica, n_seq, n_tensor),
+                    (REPLICA_AXIS, SEQ_AXIS, TENSOR_AXIS), devices)
 
 
 def make_train_step(mesh: Mesh, params_template, learning_rate: float,
@@ -344,6 +373,166 @@ def make_train_step(mesh: Mesh, params_template, learning_rate: float,
     # the global token-sum objective into the token mean is a plain
     # divide; no further collectives are needed (tensor-sharded leaves
     # keep their shard-local slice gradients).
+    grads = jax.tree.map(lambda g: g / n_data, grads)
+    new_params = jax.tree.map(lambda p, g: p - learning_rate * g,
+                              params, grads)
+    return new_params, loss
+
+  sharded = jax.shard_map(
+      body, mesh=mesh,
+      in_specs=(specs, data_spec, data_spec),
+      out_specs=(specs, P()))
+  if sp_layout == "contiguous":
+    return jax.jit(sharded, donate_argnums=(0,))
+
+  def call(params, tokens, labels):
+    order = seq_lib.zigzag_order(tokens.shape[1], n_seq)
+    return sharded(params, jnp.take(tokens, order, axis=1),
+                   jnp.take(labels, order, axis=1))
+
+  return jax.jit(call, donate_argnums=(0,))
+
+
+# ---------------------------------------------------------------------------
+# The pipeline (stage) axis composed in: dp x pp x sp x tp in one jit.
+#
+# Scope: pipeline stages require a HOMOGENEOUS layer stack (every block
+# the same pytree structure, so stages stack into leaves with a leading
+# (n_stages, layers_per_stage) axis). MoE blocks are heterogeneous
+# under moe_every and their capacity queues are defined per data shard,
+# not per microbatch -- composing ep with pp would change the queue
+# semantics silently -- so to_pipelined() rejects MoE trees; MoE
+# composition is served by make_train_step (dp x sp x tp x ep).
+# ---------------------------------------------------------------------------
+
+STAGE_AXIS = pp_lib.STAGE_AXIS
+
+
+def to_pipelined(params, n_stages: int):
+  """Standard param tree -> pipelined tree: the per-layer block list
+  becomes one stacked pytree with leading (n_stages, layers_per_stage)
+  axes (sharded on STAGE_AXIS by pipelined_param_specs)."""
+  blocks = params["blocks"]
+  if any("gate_w" in b for b in blocks):
+    raise ValueError(
+        "pipeline composition requires a homogeneous (dense) layer "
+        "stack; MoE blocks change per-shard capacity semantics under "
+        "microbatching -- use make_train_step for dp x sp x tp x ep")
+  if len(blocks) % n_stages != 0:
+    raise ValueError(f"{len(blocks)} layers not divisible by "
+                     f"{n_stages} stages")
+  lps = len(blocks) // n_stages
+  stacked = jax.tree.map(
+      lambda *xs: jnp.stack(xs).reshape(
+          (n_stages, lps) + xs[0].shape), *blocks)
+  out = {k: v for k, v in params.items() if k != "blocks"}
+  out["blocks"] = stacked
+  return out
+
+
+def from_pipelined(pparams):
+  """Inverse of to_pipelined: stacked stage tree -> per-layer list (so
+  the trained state compares leaf-for-leaf against the oracle's)."""
+  stacked = pparams["blocks"]
+  n_stages, lps = jax.tree.leaves(stacked)[0].shape[:2]
+  flat = jax.tree.map(
+      lambda x: x.reshape((n_stages * lps,) + x.shape[2:]), stacked)
+  blocks = [jax.tree.map(lambda x: x[i], flat)
+            for i in range(n_stages * lps)]
+  out = {k: v for k, v in pparams.items() if k != "blocks"}
+  out["blocks"] = blocks
+  return out
+
+
+def pipelined_param_specs():
+  """Specs for the pipelined tree: stage axis leads every block leaf;
+  the tensor axis stays on the same dims as param_specs, shifted by
+  the two stacking axes."""
+  blocks = {
+      "ln1": P(STAGE_AXIS), "ln2": P(STAGE_AXIS),
+      "wqkv": P(STAGE_AXIS, None, None, None, TENSOR_AXIS),
+      "wo": P(STAGE_AXIS, None, TENSOR_AXIS),
+      "w1": P(STAGE_AXIS, None, None, TENSOR_AXIS),
+      "b1": P(STAGE_AXIS, None, TENSOR_AXIS),
+      "w2": P(STAGE_AXIS, None, TENSOR_AXIS, None),
+      "b2": P(STAGE_AXIS),
+  }
+  return {"embed": P(), "pos": P(), "ln_f": P(), "blocks": blocks}
+
+
+def forward_local_pipelined(params, tokens, *, num_microbatches: int,
+                            seq_axis=SEQ_AXIS, tensor_axis=TENSOR_AXIS,
+                            stage_axis=STAGE_AXIS,
+                            sp_layout: str = "contiguous"):
+  """Per-shard forward with the layer stack sharded over the stage
+  axis: embed/positions everywhere (stage-replicated), the GPipe scan
+  (parallel/pipeline.py) carries activations stage-to-stage via
+  ppermute, ring attention and Megatron psums run INSIDE each stage
+  tick on the seq/tensor axes, and the retired microbatches are
+  broadcast back so the loss/unembed is stage-replicated again."""
+  x = _embed_positions(params, tokens, seq_axis=seq_axis,
+                       sp_layout=sp_layout)
+  local = jax.tree.map(lambda p: p[0], params["blocks"])
+  lps = local["ln1"].shape[0]
+
+  def stage_fn(p, xm):
+    for i in range(lps):
+      lp = jax.tree.map(lambda a: a[i], p)
+      xm, h = _attention_residual(lp, xm, seq_axis=seq_axis,
+                                  tensor_axis=tensor_axis,
+                                  sp_layout=sp_layout)
+      xm = xm + tp_lib.parallel_mlp(h, lp["w1"], lp["b1"], lp["w2"],
+                                    lp["b2"], axis_name=tensor_axis)
+    return xm
+
+  x = pp_lib.spmd_pipeline(stage_fn, local, x, num_microbatches,
+                           axis_name=stage_axis)
+  x = _rmsnorm(x, params["ln_f"])
+  return jnp.einsum("btd,vd->btv", x,
+                    params["embed"].astype(jnp.float32))
+
+
+def build_mesh_pp(n_replica: int, n_stage: int, n_seq: int,
+                  n_tensor: int, devices=None) -> Mesh:
+  return _grid_mesh(
+      (n_replica, n_stage, n_seq, n_tensor),
+      (REPLICA_AXIS, STAGE_AXIS, SEQ_AXIS, TENSOR_AXIS), devices)
+
+
+def make_pipelined_train_step(mesh: Mesh, pparams_template,
+                              learning_rate: float,
+                              num_microbatches: int,
+                              sp_layout: str = "contiguous"):
+  """Jitted SGD step over the 4-D (replica, stage, seq, tensor) mesh.
+
+  pparams_template is a to_pipelined() tree; tokens/labels are GLOBAL
+  (batch, seq) in normal order, sharded (replica, seq) and replicated
+  over stage/tensor. GPipe with full-batch SGD is mathematically the
+  sequential step, so loss AND trained params match the single-device
+  oracle (tests/test_transformer_parallel.py); num_microbatches must
+  divide the LOCAL batch (global batch / n_replica).
+  """
+  if sp_layout not in ("contiguous", "zigzag"):
+    raise ValueError(f"unknown sp_layout {sp_layout!r}")
+  del pparams_template  # shape-independent: specs are structural
+  specs = pipelined_param_specs()
+  data_spec = P(REPLICA_AXIS, SEQ_AXIS)
+  n_data = mesh.shape[REPLICA_AXIS] * mesh.shape[SEQ_AXIS]
+  n_seq = mesh.shape[SEQ_AXIS]
+
+  def body(params, tokens, labels):
+    def local_loss(p):
+      logits = forward_local_pipelined(
+          p, tokens, num_microbatches=num_microbatches,
+          sp_layout=sp_layout)
+      return _loss_from_logits(logits, labels)
+
+    loss, grads = jax.value_and_grad(local_loss)(params)
+    loss = lax.pmean(loss, (REPLICA_AXIS, SEQ_AXIS))
+    # Same pre-summed-gradient accounting as make_train_step: data-axis
+    # sums -> global token mean by a divide. Stage-sharded block leaves
+    # vary on the stage axis, so their gradients stay stage-local, just
+    # as tensor-sharded leaves stay shard-local.
     grads = jax.tree.map(lambda g: g / n_data, grads)
     new_params = jax.tree.map(lambda p, g: p - learning_rate * g,
                               params, grads)
