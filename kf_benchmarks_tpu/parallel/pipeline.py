@@ -50,17 +50,13 @@ def spmd_pipeline(stage_fn: Callable, params_local, x,
   # varying up front so the scan carry types line up. Under a COMPOSED
   # mesh (dp x pp x sp x ...) the input already varies on the data
   # axes, so the carries must carry that whole set plus the stage axis.
-  want = set(getattr(x.aval, "vma", ())) | {axis_name}
-
-  def _vary(z):
-    # pcast rejects axes the value already varies on (zeros_like keeps
-    # the source's vma), so cast only the missing ones.
-    missing = tuple(sorted(want - set(getattr(z.aval, "vma", ()))))
-    return lax.pcast(z, missing, to="varying") if missing else z
-
-  out_accum = _vary(jnp.zeros_like(mbatches))
-  # The inter-stage register travelling the pipeline.
-  state = _vary(jnp.zeros((mb,) + x.shape[1:], x.dtype))
+  from kf_benchmarks_tpu.parallel.sequence import vary_like
+  out_accum, state = vary_like(
+      mbatches,
+      (jnp.zeros_like(mbatches),
+       # The inter-stage register travelling the pipeline.
+       jnp.zeros((mb,) + x.shape[1:], x.dtype)),
+      extra_axes=(axis_name,))
 
   shift = [(i, i + 1) for i in range(s - 1)]  # non-cyclic: stage i -> i+1
 

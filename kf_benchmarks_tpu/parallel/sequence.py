@@ -71,18 +71,28 @@ def full_attention(q, k, v, causal: bool = False,
   return out.astype(q.dtype)
 
 
-def vary_like(ref, arrays, default_axes=()):
+def vary_like(ref, arrays, default_axes=(), extra_axes=()):
   """pcast zero-initialised accumulators to ``ref``'s varying set.
 
   Inside a shard_map body the Q operand is device-varying and so are
   the softmax accumulators after one update; constants must be pcast
   up front or scan/cond type checks reject the carry. ``default_axes``
-  applies when ref carries no vma information (identity if also empty).
+  applies when ref carries no vma information (identity if also empty);
+  ``extra_axes`` are unioned in regardless (e.g. the pipeline's stage
+  axis, which the input does not vary on but the carries will). Only
+  the axes each array is MISSING are pcast -- pcast rejects
+  already-varying axes.
   """
-  vma = tuple(sorted(getattr(ref.aval, "vma", ()))) or tuple(default_axes)
-  if not vma:
+  want = (set(getattr(ref.aval, "vma", ()) or default_axes)
+          | set(extra_axes))
+  if not want:
     return arrays
-  return tuple(lax.pcast(x, vma, to="varying") for x in arrays)
+
+  def cast(x):
+    missing = tuple(sorted(want - set(getattr(x.aval, "vma", ()))))
+    return lax.pcast(x, missing, to="varying") if missing else x
+
+  return tuple(cast(x) for x in arrays)
 
 
 def _block_update(q, k, v, m, l, o, scale, mask):

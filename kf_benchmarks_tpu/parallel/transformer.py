@@ -472,6 +472,15 @@ def forward_local_pipelined(params, tokens, *, num_microbatches: int,
   broadcast back so the loss/unembed is stage-replicated again."""
   x = _embed_positions(params, tokens, seq_axis=seq_axis,
                        sp_layout=sp_layout)
+  n_local = jax.tree.leaves(params["blocks"])[0].shape[0]
+  if n_local != 1:
+    # Same hazard make_pipeline guards: a stage count that merely
+    # DIVIDES the axis size shards legally but p[0] would silently
+    # drop every local stage after the first.
+    raise ValueError(
+        f"blocks leading axis must equal the '{stage_axis}' mesh axis "
+        f"size (one stage per device); got a local slice of {n_local} "
+        f"stages")
   local = jax.tree.map(lambda p: p[0], params["blocks"])
   lps = local["ln1"].shape[0]
 

@@ -248,6 +248,19 @@ def test_pipelined_round_trip_and_rejections():
     transformer.to_pipelined(moe, 2)
 
 
+def test_pipelined_rejects_stage_mesh_mismatch():
+  # A stage count that merely DIVIDES the mesh axis size shards
+  # legally, but each device would hold >1 stage and p[0] would
+  # silently drop the rest -- must refuse, not train on half the net.
+  params, pparams, tokens, labels, mesh = _pipelined_setup((1, 2, 2, 2))
+  wrong = transformer.to_pipelined(transformer.from_pipelined(pparams),
+                                   4)  # 4 stages onto a 2-stage axis
+  step = transformer.make_pipelined_train_step(
+      mesh, wrong, learning_rate=0.1, num_microbatches=2)
+  with pytest.raises(ValueError, match="one stage per device"):
+    step(wrong, tokens, labels)
+
+
 def test_alternate_mesh_shapes():
   # Degenerate axes must work too: pure-sp (1, 8, 1) and pure-tp
   # (1, 1, 4) meshes run the same program.
