@@ -34,16 +34,23 @@ import jax.numpy as jnp
 
 from kf_benchmarks_tpu.parallel import sequence
 
-B, H, D = 1, 8, 128
+H, D = 8, 128
 BLOCK = 512  # default; --block overrides
 
 
-def make_rep(impl, l, dtype, block=BLOCK):
+def make_rep(impl, l, dtype, block=BLOCK, batch=1, q_block=None):
   ks = jax.random.split(jax.random.PRNGKey(0), 3)
-  q, k, v = (jax.random.normal(kk, (B, l, H, D), dtype) for kk in ks)
+  q, k, v = (jax.random.normal(kk, (batch, l, H, D), dtype)
+             for kk in ks)
 
   if impl == "full":
     attn = lambda q, k, v: sequence.full_attention(q, k, v, causal=True)
+  elif impl == "tiled":
+    # Two-level q x kv tiling: block-sized accumulators + causal skip
+    # of strictly-future K/V blocks (the round-5 MFU work).
+    attn = lambda q, k, v: sequence.blockwise_attention(
+        q, k, v, block_size=block, causal=True,
+        q_block_size=block if q_block is None else q_block)
   else:
     attn = lambda q, k, v: sequence.blockwise_attention(
         q, k, v, block_size=block, causal=True)
@@ -80,12 +87,18 @@ def sync_time(f, args, reps, iters):
   return min(ts)
 
 
-def measure(impl, l, dtype, block=BLOCK):
+def measure(impl, l, dtype, block=BLOCK, batch=1, q_block=None):
   reps_small, reps_big, iters = _reps_for(l)
-  rep, args = make_rep(impl, l, dtype, block)
+  rep, args = make_rep(impl, l, dtype, block, batch, q_block)
   t_small = sync_time(rep, args, reps_small, iters)
   t_big = sync_time(rep, args, reps_big, iters)
   return (t_big - t_small) / (reps_big - reps_small)
+
+
+def causal_tflops(l, batch):
+  """Useful (unmasked) causal attention FLOPs: 2 matmuls x B H L^2/2 D
+  MACs x 2 flops/MAC."""
+  return 2 * 2 * batch * H * (l * l / 2) * D / 1e12
 
 
 def main():
@@ -94,37 +107,47 @@ def main():
   ap.add_argument("--lengths", type=int, nargs="+",
                   default=[2048, 4096, 8192, 16384, 32768, 65536])
   ap.add_argument("--block", type=int, default=BLOCK)
+  ap.add_argument("--q_block", type=int, default=None)
+  ap.add_argument("--batch", type=int, nargs="+", default=[1])
+  ap.add_argument("--impls", nargs="+",
+                  choices=["full", "blockwise", "tiled"],
+                  default=["full", "blockwise", "tiled"])
   args = ap.parse_args()
   dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
 
   print(f"devices: {jax.devices()}")
   rows = []
-  for l in args.lengths:
-    row = {"L": l}
-    for impl in ("full", "blockwise"):
-      try:
-        dt = measure(impl, l, dtype, args.block)
-        row[impl] = dt
-        print(f"L={l} {impl}: {dt*1e3:.2f} ms ({l/dt:,.0f} tok/s)",
-              flush=True)
-      except Exception as e:  # noqa: BLE001 -- OOM is an expected arm
-        row[impl] = None
-        print(f"L={l} {impl}: FAILED ({type(e).__name__}: "
-              f"{str(e)[:120]})", flush=True)
-    rows.append(row)
+  for batch in args.batch:
+    for l in args.lengths:
+      row = {"L": l, "B": batch}
+      for impl in args.impls:
+        try:
+          dt = measure(impl, l, dtype, args.block, batch, args.q_block)
+          row[impl] = dt
+          print(f"B={batch} L={l} {impl}: {dt*1e3:.2f} ms "
+                f"({batch*l/dt:,.0f} tok/s, "
+                f"{causal_tflops(l, batch)/dt:.1f} TFLOP/s eff)",
+                flush=True)
+        except Exception as e:  # noqa: BLE001 -- OOM is an expected arm
+          row[impl] = None
+          print(f"B={batch} L={l} {impl}: FAILED ({type(e).__name__}: "
+                f"{str(e)[:120]})", flush=True)
+      rows.append(row)
 
-  print(f"\nB={B} H={H} D={D} block={args.block} dtype={args.dtype}, causal")
-  print("| L | full ms | full tok/s | blockwise ms | blockwise tok/s |")
-  print("|---|---|---|---|---|")
+  print(f"\nH={H} D={D} block={args.block} q_block="
+        f"{args.q_block or args.block} dtype={args.dtype}, causal")
+  hdr = " | ".join(f"{i} ms | {i} TFLOP/s" for i in args.impls)
+  print(f"| B | L | {hdr} |")
+  print("|---" * (2 + 2 * len(args.impls)) + "|")
   for r in rows:
     cells = []
-    for impl in ("full", "blockwise"):
-      if r[impl] is None:
+    for impl in args.impls:
+      if r.get(impl) is None:
         cells += ["OOM", "-"]
       else:
-        cells += [f"{r[impl]*1e3:.2f}", f"{r['L']/r[impl]:,.0f}"]
-    print(f"| {r['L']} | {cells[0]} | {cells[1]} | {cells[2]} | "
-          f"{cells[3]} |")
+        cells += [f"{r[impl]*1e3:.2f}",
+                  f"{causal_tflops(r['L'], r['B'])/r[impl]:.1f}"]
+    print(f"| {r['B']} | {r['L']} | " + " | ".join(cells) + " |")
 
 
 if __name__ == "__main__":

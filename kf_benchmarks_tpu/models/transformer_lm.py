@@ -36,6 +36,9 @@ N_LAYERS = 6
 N_HEADS = 8
 D_FF = 2048
 ATTN_BLOCK = 512
+# Two-level (q x kv) tiling: accumulators stay q-block-sized instead of
+# full-length, and causal runs skip strictly-future K/V blocks.
+ATTN_Q_BLOCK = 512
 
 
 class _TransformerLMModule(nn.Module):
@@ -45,6 +48,7 @@ class _TransformerLMModule(nn.Module):
   n_heads: int = N_HEADS
   d_ff: int = D_FF
   attn_block: int = ATTN_BLOCK
+  attn_q_block: int = ATTN_Q_BLOCK
   max_len: int = SEQ_LEN
   dtype: Any = jnp.float32
   param_dtype: Any = jnp.float32
@@ -76,7 +80,8 @@ class _TransformerLMModule(nn.Module):
       qkv = qkv.reshape(b, t, 3, self.n_heads, head_dim)
       att = sequence_lib.blockwise_attention(
           qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
-          block_size=min(self.attn_block, t), causal=True)
+          block_size=min(self.attn_block, t), causal=True,
+          q_block_size=min(self.attn_q_block, t))
       x = x + dense(self.d_model, f"attn_out_{i}")(
           att.reshape(b, t, self.d_model))
       h = ln(f"ln2_{i}")(x).astype(self.dtype)

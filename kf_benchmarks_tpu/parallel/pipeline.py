@@ -23,6 +23,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kf_benchmarks_tpu.parallel.sequence import vary_like
+
 STAGE_AXIS = "stage"
 
 
@@ -50,7 +52,6 @@ def spmd_pipeline(stage_fn: Callable, params_local, x,
   # varying up front so the scan carry types line up. Under a COMPOSED
   # mesh (dp x pp x sp x ...) the input already varies on the data
   # axes, so the carries must carry that whole set plus the stage axis.
-  from kf_benchmarks_tpu.parallel.sequence import vary_like
   out_accum, state = vary_like(
       mbatches,
       (jnp.zeros_like(mbatches),

@@ -306,7 +306,8 @@ def ring_attention_zigzag(q, k, v, axis_name: str = SEQ_AXIS,
 
 
 def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
-                        scale: Optional[float] = None):
+                        scale: Optional[float] = None,
+                        q_block_size: Optional[int] = None):
   """Single-device flash-style attention: lax.scan over K/V blocks with
   the same online softmax as the ring schedule, so forward peak memory
   is O(L * block) instead of O(L^2) and long contexts fit in HBM on one
@@ -316,6 +317,16 @@ def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
   nblk full-score residuals; its stored state is the scan carry stack,
   O(L^2 * D / block) -- ~5*block/D x smaller than unrematerialised
   residuals (block=512, D=64: ~40x).
+
+  ``q_block_size`` selects the two-level (flash-style) tiling: an
+  outer scan over q blocks, an inner scan over K/V blocks, so the
+  softmax accumulators are (.., q_block) tiles instead of full-length
+  (.., L) arrays -- the single-level path re-reads O(L)-sized m/l/o
+  from HBM on every K/V step, which is what made the measured
+  long-context MFU bandwidth-lean (PERF.md round 4). Under ``causal``
+  the inner scan also SKIPS K/V blocks strictly in the q block's
+  future via lax.cond, recovering the ~2x of FLOPs the single-level
+  path spends on fully-masked tiles.
 
   (B, L, H, D) -> (B, L, H, D); L % block_size == 0. Composes with
   ring_attention -- inside a ring step each device could scan its local
@@ -331,24 +342,66 @@ def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
   kb = k.reshape(b, nblk, block_size, h, d).swapaxes(0, 1)
   vb = v.reshape(b, nblk, block_size, h, d).swapaxes(0, 1)
 
-  m0, l0, o0 = vary_like(
-      q,
-      (jnp.full((b, h, l), _NEG, jnp.float32),
-       jnp.zeros((b, h, l), jnp.float32),
-       jnp.zeros((b, l, h, d), jnp.float32)))
+  if q_block_size is None:
+    m0, l0, o0 = vary_like(
+        q,
+        (jnp.full((b, h, l), _NEG, jnp.float32),
+         jnp.zeros((b, h, l), jnp.float32),
+         jnp.zeros((b, l, h, d), jnp.float32)))
 
-  def step(carry, inp):
-    m, acc_l, o = carry
-    j, kj, vj = inp
-    offsets = (0, j * block_size) if causal else None
-    m, acc_l, o = _block_update_remat(q, kj, vj, m, acc_l, o, scale_,
-                                      offsets, prevent_cse=False)
-    return (m, acc_l, o), None
+    def step(carry, inp):
+      m, acc_l, o = carry
+      j, kj, vj = inp
+      offsets = (0, j * block_size) if causal else None
+      m, acc_l, o = _block_update_remat(q, kj, vj, m, acc_l, o, scale_,
+                                        offsets, prevent_cse=False)
+      return (m, acc_l, o), None
 
-  (m, acc_l, o), _ = lax.scan(
-      step, (m0, l0, o0), (jnp.arange(nblk), kb, vb))
-  out = o / jnp.maximum(acc_l, 1e-30).swapaxes(1, 2)[..., None]
-  return out.astype(q.dtype)
+    (m, acc_l, o), _ = lax.scan(
+        step, (m0, l0, o0), (jnp.arange(nblk), kb, vb))
+    out = o / jnp.maximum(acc_l, 1e-30).swapaxes(1, 2)[..., None]
+    return out.astype(q.dtype)
+
+  if l % q_block_size != 0:
+    raise ValueError(
+        f"seq len {l} not divisible by q block {q_block_size}")
+  nq = l // q_block_size
+  qb = q.reshape(b, nq, q_block_size, h, d).swapaxes(0, 1)
+
+  def q_step(_, q_inp):
+    qi, qi_blk = q_inp
+    acc0 = vary_like(
+        q,
+        (jnp.full((b, h, q_block_size), _NEG, jnp.float32),
+         jnp.zeros((b, h, q_block_size), jnp.float32),
+         jnp.zeros((b, q_block_size, h, d), jnp.float32)))
+
+    def kv_step(carry, kv_inp):
+      j, kj, vj = kv_inp
+
+      def do(c):
+        offs = (qi * q_block_size, j * block_size) if causal else None
+        return _block_update_remat(qi_blk, kj, vj, *c, scale_, offs,
+                                   prevent_cse=False)
+
+      if causal:
+        # K/V block j is strictly in this q block's future iff its
+        # first key position exceeds the q block's last row.
+        has_work = j * block_size <= qi * q_block_size + (
+            q_block_size - 1)
+        carry = lax.cond(has_work, do, lambda c: c, carry)
+      else:
+        carry = do(carry)
+      return carry, None
+
+    (m, acc_l, o), _ = lax.scan(
+        kv_step, acc0, (jnp.arange(nblk), kb, vb))
+    out = o / jnp.maximum(acc_l, 1e-30).swapaxes(1, 2)[..., None]
+    return None, out
+
+  _, outs = lax.scan(q_step, None, (jnp.arange(nq), qb))
+  # (nq, B, qb, H, D) -> (B, L, H, D)
+  return outs.swapaxes(0, 1).reshape(b, l, h, d).astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
