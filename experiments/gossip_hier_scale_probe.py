@@ -158,12 +158,12 @@ def main():
         "not parallel wall clock\n")
 
   if args.only in (None, "gossip"):
-    print("## pair_average: switch vs gated lowering")
+    print("## pair_average: full-rotation switch vs hypercube schedule")
     print("| n | lowering | HLO bytes | collective-permutes | step ms |")
     print("|---|---|---|---|---|")
     for n in (8, 16, 32):
-      for label, switch_max in (("switch (1 send)", n),
-                                ("gated log2 hops", 1)):
+      for label, switch_max in (("switch (full rotation)", n),
+                                ("hypercube (1 send)", 1)):
         hlo, nperm, med = gossip_probe(n, switch_max, args.repeats)
         print(f"| {n} | {label} | {hlo} | {nperm} | {med * 1e3:.2f} |",
               flush=True)

@@ -96,71 +96,86 @@ def broadcast(tree, root: int = 0, axis_name: str = REPLICA_AXIS):
   return jax.tree.map(bcast, tree)
 
 
+# Axis size at or below which the gossip schedule is the full 1..n-1
+# rotation; above it, the hypercube schedule keeps the program at
+# ceil(log2 n) switch branches AND one send per step.
+GOSSIP_SWITCH_MAX_N = 8
+
+
+def _gossip_offsets(n: int):
+  """Per-period partner offsets of the gossip schedule at axis size n.
+
+  The single source of truth shared by gossip_shift (step -> offset
+  lookup) and pair_average (one switch branch per offset), so the two
+  can never drift. 2^k here is always < n (k < (n-1).bit_length()), so
+  every offset is a valid non-zero cyclic shift.
+  """
+  if n <= GOSSIP_SWITCH_MAX_N:
+    return list(range(1, n))
+  return [1 << k for k in range((n - 1).bit_length())]
+
+
 def gossip_shift(step, axis_size: int):
   """Deterministic peer offset for pair-averaging at this step.
 
-  AD-PSGD's asynchronous random pairing has no SPMD analog; the
-  convergence-equivalent synchronous schedule rotates the partner offset
-  through 1..n-1 so every replica mixes with every other within n-1 steps
-  (SURVEY 7.4 "Pair-averaging gossip on TPU").
+  AD-PSGD's asynchronous random pairing has no SPMD analog, so the
+  schedule is a deterministic synchronous rotation (SURVEY 7.4
+  "Pair-averaging gossip on TPU"), sized to the axis:
+
+  * n <= GOSSIP_SWITCH_MAX_N: the offset rotates through 1..n-1, so
+    every replica pairs with every other within n-1 steps.
+  * n > GOSSIP_SWITCH_MAX_N: HYPERCUBE offsets -- 2^(step mod
+    ceil(log2 n)) mod n. Every offset is a single cyclic permutation
+    (one ppermute, ONE tree-sized send), and the binary expansion
+    connects all n replicas within ceil(log2 n) steps -- faster mixing
+    than the 1..n-1 rotation needs n-1 steps for, at 1/log2(n) of the
+    wire cost the round-2 gated-hop lowering paid (which sent the tree
+    on every of its log2 n hops and gated the result; measured 2.1x
+    step time at n=32, PERF.md round 4).
   """
+  step = jnp.asarray(step)
   if axis_size <= 1:
-    return jnp.zeros_like(jnp.asarray(step))
-  return 1 + jnp.asarray(step) % (axis_size - 1)
-
-
-# Axis size at or below which pair_average bakes all shifts into a
-# lax.switch (one send per step); above it, gated power-of-two hops keep
-# the program O(log n) at the cost of up to log2(n) sends per step.
-GOSSIP_SWITCH_MAX_N = 8
+    return jnp.zeros_like(step)
+  offsets = _gossip_offsets(axis_size)
+  return jnp.asarray(offsets, jnp.int32)[step % len(offsets)]
 
 
 def pair_average(tree, step, axis_name: str = REPLICA_AXIS):
   """One gossip round: average weights with the step's partner
   (KungFu PairAveragingOptimizer data plane -> ppermute).
 
-  Each replica i receives from (i - shift) mod n and averages. This is the
-  row-stochastic gossip matrix W = (I + P)/2 with P a cyclic permutation:
-  doubly stochastic, so the network average is preserved exactly -- the
-  property AD-PSGD's analysis needs. Both lowerings below compute the
-  identical permutation, so results are bit-equal across the threshold.
+  Each replica i receives from (i - shift) mod n and averages, with
+  shift = gossip_shift(step, n). This is the row-stochastic gossip
+  matrix W = (I + P)/2 with P a cyclic permutation: doubly stochastic,
+  so the network average is preserved exactly -- the property
+  AD-PSGD's analysis needs. Every branch of either lowering is a
+  single ppermute of the whole tree, so a gossip step costs exactly
+  one tree-sized send at ANY n; the schedules differ across the
+  threshold (1..n-1 rotation vs hypercube offsets, see gossip_shift)
+  but both are doubly stochastic every step and fully mixing over
+  their window.
   """
   n = lax.axis_size(axis_name)
   if n == 1:
     return tree
-  shift = jnp.asarray(gossip_shift(step, n), jnp.int32)
-  if n <= GOSSIP_SWITCH_MAX_N:
-    # Small axes: bake each cyclic shift as a switch branch -- exactly
-    # ONE tree-sized send per gossip step, at n-1 branches of program.
-    def make_branch(s):
-      perm = [(i, (i + s) % n) for i in range(n)]
-      return lambda t: jax.tree.map(
-          lambda x: lax.ppermute(x, axis_name, perm), t)
-    shifted = lax.switch(shift - 1, [make_branch(s) for s in range(1, n)],
-                         tree)
-  else:
-    # At scale the cyclic shift decomposes into gated power-of-two hops
-    # (binary digits of the shift), so the program holds ceil(log2 n)
-    # static ppermutes instead of n-1 switch branches (n=256 would bake
-    # 255). The trade is wire traffic: every hop sends the full tree and
-    # the gate discards unused hops, so a gossip step costs up to
-    # ceil(log2 n) tree-sized sends where the switch costs one -- paid
-    # only above the threshold, where the O(n^2) program would be worse.
-    # ppermute moves data without arithmetic, so the composed result is
-    # bit-identical to a single shift-s permutation; the partner still
-    # varies per step without retracing (the gates read the shift's
-    # bits).
-    shifted = tree
-    for k in range((n - 1).bit_length()):
-      # hop is never 0 mod n: for power-of-two n every 1<<k here is < n,
-      # and otherwise n has an odd factor no power of two divides.
-      hop = (1 << k) % n
-      perm = [(i, (i + hop) % n) for i in range(n)]
-      take_hop = ((shift >> k) & 1).astype(jnp.bool_)
-      shifted = jax.tree.map(
-          lambda x, p=perm: jnp.where(
-              take_hop, lax.ppermute(x, axis_name, p), x),
-          shifted)
+  step = jnp.asarray(step)
+
+  def make_branch(s):
+    perm = [(i, (i + s) % n) for i in range(n)]
+    return lambda t: jax.tree.map(
+        lambda x: lax.ppermute(x, axis_name, perm), t)
+
+  # One switch branch per schedule offset: n-1 branches of the full
+  # rotation at small n, ceil(log2 n) hypercube branches at scale
+  # (n=256 bakes 8, not 255) -- every branch a single tree-sized send.
+  # The round-2 design instead decomposed the full rotation into gated
+  # power-of-two hops, which kept the program O(log n) but sent the
+  # tree on EVERY hop (measured 2.1x step time at n=32); restricting
+  # the schedule itself to the power-of-two offsets removes the extra
+  # sends instead of gating them.
+  offsets = _gossip_offsets(n)
+  shifted = lax.switch(step % len(offsets),
+                       [make_branch(s) for s in offsets], tree)
   return jax.tree.map(lambda x, y: 0.5 * (x + y), tree, shifted)
 
 
