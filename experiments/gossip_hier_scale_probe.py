@@ -1,15 +1,16 @@
 """Gossip + hierarchical-reduction scaling on the virtual CPU mesh
-(VERDICT r3 weak #3 / next-round #4): make the log2(n)-sends trade of the
-gated pair_average lowering and the hier-vs-flat-psum cost a MEASURED
-fact, not a code comment.
+(VERDICT r3 weak #3 / r4 weak #5): make the gossip schedule's wire
+cost and the hier-vs-flat-psum cost a MEASURED fact, not a comment.
 
 For n in {8, 16, 32} (32 virtual CPU devices, submeshes for smaller n):
 
-  pair_average  -- switch lowering (n <= GOSSIP_SWITCH_MAX_N: one
-                   tree-sized send/step, n-1 baked branches) vs gated
-                   power-of-two-hop lowering (ceil(log2 n) sends/step,
-                   flat program): HLO bytes, collective_permute count,
-                   and measured step wall time.
+  pair_average  -- full-rotation switch (n-1 baked branches, one
+                   tree-sized send/step) vs the at-scale HYPERCUBE
+                   schedule (ceil(log2 n) switch branches, each ONE
+                   single-ppermute send -- the round-5 replacement for
+                   the gated-hop lowering that sent the tree log2(n)
+                   times per step): HLO bytes, collective_permute
+                   count, and measured step wall time.
   reducers      -- flat psum vs rsag (#shards) vs hier (grouped ring) on
                    a 4 MB gradient vector: HLO bytes + step wall time.
 

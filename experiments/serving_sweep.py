@@ -61,19 +61,31 @@ def main():
     fwd = run_cli(base + [f"--batch_size={bs}", "--forward_only"])
     with tempfile.TemporaryDirectory() as td:
       blob = os.path.join(td, "model.bin")
+      blob8 = os.path.join(td, "model_int8.bin")
       run_cli(base + [f"--batch_size={bs}", "--forward_only",
                       f"--aot_save_path={blob}", "--num_batches=5"])
       aot = run_cli(base + [f"--batch_size={bs}", "--forward_only",
                             f"--aot_load_path={blob}"])
-    rows.append((bs, fwd, 1e3 * bs / fwd, aot, 1e3 * bs / aot))
+      # The TRT INT8 analog: weight-only quantized export
+      # (quantization.py), benchmarked the same way.
+      run_cli(base + [f"--batch_size={bs}", "--forward_only",
+                      f"--aot_save_path={blob8}", "--trt_mode=INT8",
+                      "--num_batches=5"])
+      aot8 = run_cli(base + [f"--batch_size={bs}", "--forward_only",
+                             f"--aot_load_path={blob8}"])
+    rows.append((bs, fwd, 1e3 * bs / fwd, aot, 1e3 * bs / aot,
+                 aot8, 1e3 * bs / aot8))
     print(f"bs={bs}: forward {fwd:.0f} img/s ({rows[-1][2]:.2f} ms/batch), "
-          f"aot {aot:.0f} img/s ({rows[-1][4]:.2f} ms/batch)", flush=True)
+          f"aot {aot:.0f} img/s ({rows[-1][4]:.2f} ms/batch), "
+          f"aot-int8 {aot8:.0f} img/s ({rows[-1][6]:.2f} ms/batch)",
+          flush=True)
 
   print("\n| bs | forward img/s | forward ms/batch | aot img/s | "
-        "aot ms/batch |")
-  print("|---|---|---|---|---|")
-  for bs, f_ips, f_ms, a_ips, a_ms in rows:
-    print(f"| {bs} | {f_ips:.0f} | {f_ms:.2f} | {a_ips:.0f} | {a_ms:.2f} |")
+        "aot ms/batch | aot-int8 img/s | aot-int8 ms/batch |")
+  print("|---|---|---|---|---|---|---|")
+  for bs, f_ips, f_ms, a_ips, a_ms, q_ips, q_ms in rows:
+    print(f"| {bs} | {f_ips:.0f} | {f_ms:.2f} | {a_ips:.0f} | {a_ms:.2f}"
+          f" | {q_ips:.0f} | {q_ms:.2f} |")
 
 
 if __name__ == "__main__":

@@ -24,20 +24,33 @@ from jax import export as jax_export
 
 
 def export_forward(model, variables, batch_size: int, path: str,
-                   nclass: int = 1001, dtype=jnp.float32) -> int:
+                   nclass: int = 1001, dtype=jnp.float32,
+                   quantize: bool = False) -> int:
   """Serialize the frozen forward pass to ``path``; returns byte size.
 
   ``variables`` (trained params + batch stats) are captured as constants
   (the freeze step); the exported module takes only the input batch.
+  ``quantize`` stores the large kernels as int8 + per-channel scales
+  and dequantizes inside the program -- the TRT INT8 analog
+  (quantization.py; ref --trt_mode :615-620, conversion :2466-2486).
   """
   model.set_batch_size(batch_size)
   module = model.make_module(nclass=nclass, phase_train=False,
                              data_format="NHWC", dtype=dtype,
                              param_dtype=jnp.float32)
 
-  def frozen_forward(images):
-    logits, _ = module.apply(variables, images)
-    return logits
+  if quantize:
+    from kf_benchmarks_tpu import quantization
+    qvars = quantization.quantize_variables(variables)
+
+    def frozen_forward(images):
+      fvars = quantization.dequantize_variables(qvars, jnp.float32)
+      logits, _ = module.apply(fvars, images)
+      return logits
+  else:
+    def frozen_forward(images):
+      logits, _ = module.apply(variables, images)
+      return logits
 
   image_shape = tuple(model.get_input_shapes("eval")[0])
   spec = jax.ShapeDtypeStruct(image_shape, jnp.float32)

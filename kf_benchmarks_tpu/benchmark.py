@@ -685,12 +685,18 @@ class BenchmarkCNN:
       bs = jax.tree.map(lambda x: x[0], state.batch_stats)
       if bs:
         variables["batch_stats"] = bs
+      trt_mode = (p.trt_mode or "").upper()
+      export_dtype = {"FP32": jnp.float32, "FP16": jnp.bfloat16,
+                      "INT8": jnp.bfloat16}.get(trt_mode,
+                                                self.compute_dtype)
       nbytes = aot.export_forward(
           self.model, variables, self.batch_size_per_device,
           p.aot_save_path, nclass=self.dataset.num_classes,
-          dtype=self.compute_dtype)
+          dtype=export_dtype, quantize=trt_mode == "INT8")
       log_fn(f"Exported frozen forward program to {p.aot_save_path} "
-             f"({nbytes} bytes)")
+             f"({nbytes} bytes"
+             + (f", {trt_mode} serving precision" if trt_mode else "")
+             + ")")
 
     # Observability wiring (SURVEY 5.1/5.5; see observability.py).
     bench_logger = None

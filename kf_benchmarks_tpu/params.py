@@ -320,9 +320,12 @@ flags.DEFINE_string("debugger", None,
                     "tfdbg has no TPU analog; any value is rejected "
                     "(ref :370-377).")
 flags.DEFINE_string("trt_mode", "",
-                    "TensorRT conversion has no TPU analog; non-empty "
-                    "values are rejected -- use --aot_save_path, the "
-                    "XLA-native frozen-serving path (ref :615-620).")
+                    "Precision of the frozen serving export (the "
+                    "TensorRT-conversion analog, ref :615-620): FP32, "
+                    "FP16 (bf16 compute on TPU), or INT8 (weight-only "
+                    "post-training quantization, quantization.py). "
+                    "Requires --forward_only with --aot_save_path; "
+                    "empty keeps the training compute dtype.")
 flags.DEFINE_boolean("freeze_when_forward_only", False,
                      "Accepted for parity: freezing IS the AOT export "
                      "(--aot_save_path folds weights into constants; "

@@ -1,0 +1,95 @@
+"""Post-training weight quantization for the frozen serving path.
+
+The reference's serving mode converts the frozen graph with TensorRT at
+FP32/FP16/INT8 precision (ref: scripts/tf_cnn_benchmarks/
+benchmark_cnn.py:2466-2486 _GraphInfo TRT conversion, flags :615-620
+--trt_mode). The TPU-native INT8 analog is weight-only post-training
+quantization of the AOT-exported forward program:
+
+* each large float kernel is stored as symmetric per-output-channel
+  int8 (q = round(w / scale), scale = max|w| / 127 over the output
+  channel), biases/norm parameters stay float;
+* dequantization (q * scale -> compute dtype) happens INSIDE the
+  exported program, so the serialized artifact carries 1-byte weight
+  constants (~4x smaller than f32) and the chip reads weights from HBM
+  at a quarter of the bandwidth -- the win TRT INT8 buys on GPUs, in
+  the place a TPU serving program actually spends it;
+* matmuls/convs execute in the compute dtype (bf16 on TPU) after the
+  inline dequant; XLA fuses the scale multiply into the weight load.
+
+Activation quantization (TRT's calibration pass) is deliberately NOT
+replicated: on TPU the MXU computes bf16 at full rate, so activation
+int8 buys bandwidth only on the (small) activation tensors while
+costing a calibration sweep; weight-only PTQ keeps the artifact
+self-contained, needs no calibration data, and preserves accuracy
+(pinned by tests/test_quantization.py's accuracy-delta check).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Leaves smaller than this stay float: biases, norm scales, and other
+# vectors are bandwidth-irrelevant and precision-critical.
+MIN_QUANT_ELEMS = 4096
+
+_QKEY = "__int8__"
+_SKEY = "__scale__"
+
+
+def _is_qleaf(x) -> bool:
+  return isinstance(x, dict) and _QKEY in x and _SKEY in x
+
+
+def quantize_variables(variables, min_elems: int = MIN_QUANT_ELEMS):
+  """Float kernels -> {int8 q, f32 per-out-channel scale} leaves.
+
+  Symmetric per-output-channel quantization over the LAST axis (the
+  output-features axis of both dense (in, out) and conv (h, w, in, out)
+  kernels): scale[c] = max|w[..., c]| / 127. Leaves that are not float,
+  have fewer than 2 axes, or fewer than ``min_elems`` elements pass
+  through unchanged.
+  """
+
+  def quant(w):
+    if (not isinstance(w, jnp.ndarray) and not hasattr(w, "dtype")):
+      return w
+    if (w.ndim < 2 or w.size < min_elems
+        or not jnp.issubdtype(w.dtype, jnp.floating)):
+      return w
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)),
+                     axis=tuple(range(w.ndim - 1)))
+    scale = jnp.maximum(absmax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return {_QKEY: q.astype(jnp.int8), _SKEY: scale}
+
+  return jax.tree.map(quant, variables)
+
+
+def dequantize_variables(qvars, dtype=jnp.float32):
+  """Inverse of quantize_variables, usable inside jit: int8 leaves are
+  rebuilt as (q * scale) in ``dtype``; float leaves pass through."""
+
+  def dequant(leaf):
+    if _is_qleaf(leaf):
+      return (leaf[_QKEY].astype(jnp.float32)
+              * leaf[_SKEY]).astype(dtype)
+    return leaf
+
+  return jax.tree.map(dequant, qvars, is_leaf=_is_qleaf)
+
+
+def quantized_fraction(qvars) -> float:
+  """Fraction of parameter ELEMENTS stored as int8 -- a sanity metric
+  for logs/tests (a model whose kernels all fell under the size
+  threshold serves no quantization purpose)."""
+  q_elems = total = 0
+  for leaf in jax.tree.leaves(
+      qvars, is_leaf=lambda x: _is_qleaf(x)):
+    if _is_qleaf(leaf):
+      q_elems += leaf[_QKEY].size
+      total += leaf[_QKEY].size
+    elif hasattr(leaf, "size"):
+      total += leaf.size
+  return q_elems / max(total, 1)

@@ -193,10 +193,16 @@ def validate_cross_flags(params) -> None:
     raise ParamError("--debugger: tfdbg has no TPU analog "
                      "(ref :370-377); use --trace_file / --tfprof_file "
                      "for profiling and --graph_file for program dumps")
-  if getattr(p, "trt_mode", ""):
-    raise ParamError("--trt_mode: TensorRT conversion has no TPU analog "
-                     "(ref :615-620); --aot_save_path exports the frozen "
-                     "XLA serving program instead")
+  trt_mode = (getattr(p, "trt_mode", "") or "").upper()
+  if trt_mode and trt_mode not in ("FP32", "FP16", "INT8"):
+    raise ParamError(f"--trt_mode: unknown mode {p.trt_mode!r}; the "
+                     "serving-export precisions are FP32, FP16, INT8 "
+                     "(ref :615-620)")
+  if trt_mode and not getattr(p, "aot_save_path", None):
+    raise ParamError("--trt_mode sets the precision of the frozen "
+                     "serving export and requires --forward_only with "
+                     "--aot_save_path (the TRT conversion analog, ref "
+                     ":615-620, :2466-2486)")
   if p.aot_load_path and not p.forward_only:
     raise ParamError("--aot_load_path requires --forward_only (the "
                      "frozen artifact has no training program; ref: "

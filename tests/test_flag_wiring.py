@@ -288,10 +288,22 @@ class TestParityCorpus:
     with pytest.raises(validation.ParamError, match="tfdbg"):
       validation.validate_cross_flags(p)
 
-  def test_trt_mode_rejected_with_aot_pointer(self):
+  def test_trt_mode_requires_aot_export(self):
+    # trt_mode is the serving-export precision knob; without the export
+    # path there is nothing to convert (ref :615-620).
     p = params_lib.make_params(trt_mode="FP16")
     with pytest.raises(validation.ParamError, match="aot_save_path"):
       validation.validate_cross_flags(p)
+
+  def test_trt_mode_rejects_unknown_precision(self):
+    p = params_lib.make_params(trt_mode="INT4")
+    with pytest.raises(validation.ParamError, match="unknown mode"):
+      validation.validate_cross_flags(p)
+
+  def test_trt_mode_int8_accepted_with_export(self, tmp_path):
+    p = params_lib.make_params(trt_mode="INT8", forward_only=True,
+                               aot_save_path=str(tmp_path / "m.bin"))
+    validation.validate_cross_flags(p)
 
   def test_repeat_cached_sample_serves_one_record(self, tmp_path):
     import os
