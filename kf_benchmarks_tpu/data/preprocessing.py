@@ -458,8 +458,26 @@ class MultiprocessImagePreprocessor(RecordInputImagePreprocessor):
                num_buffers: int = 3,
                input_bytes_per_image: int = 256 << 10, **kwargs):
     super().__init__(*args, **kwargs)
-    self.num_processes = max(1, num_processes or self.num_threads or
-                             os.cpu_count() or 1)
+    try:  # available (affinity/cgroup-visible) cores, not host cores
+      cores = len(os.sched_getaffinity(0))
+    except AttributeError:  # non-Linux
+      cores = os.cpu_count() or 1
+    if num_processes:
+      # An EXPLICIT worker count is honored (experiments sweep
+      # oversubscription on purpose; tests exercise multi-worker slice
+      # paths on 1-core hosts) -- with the measured warning attached.
+      self.num_processes = max(1, num_processes)
+      if self.num_processes > cores:
+        from kf_benchmarks_tpu.utils import log as log_util
+        log_util.log_fn(
+            f"Decode pool oversubscribed: {self.num_processes} workers "
+            f"on {cores} available core(s) -- contention HALVED decode "
+            "throughput at 8-on-1 (PERF.md round-4 measurement)")
+    else:
+      # The DEFAULTED size is capped at the available cores: workers
+      # beyond them only contend (8 workers on 1 core halved decode
+      # throughput, PERF.md round 4).
+      self.num_processes = min(max(1, self.num_threads or cores), cores)
     self.num_buffers = max(2, num_buffers)
     # Staging capacity per image slot; 256 KiB covers ~99% of ImageNet
     # JPEGs (mean ~110 KiB). Oversized records ride the inline fallback.

@@ -179,6 +179,22 @@ def test_multiprocess_preprocessor_batched_dispatch(tmp_path):
   assert pre.dispatch_seconds >= 0.0
 
 
+def test_multiprocess_preprocessor_caps_defaulted_workers():
+  """Workers beyond the available cores only contend (8 workers on 1
+  core HALVED decode throughput -- PERF.md round 4): the DEFAULTED pool
+  size is capped at the affinity-visible core count, while an explicit
+  num_processes is honored (experiments sweep oversubscription on
+  purpose)."""
+  cores = len(os.sched_getaffinity(0))
+  kw = dict(batch_size=4, output_shape=(24, 24, 3), train=False)
+  defaulted = preprocessing.MultiprocessImagePreprocessor(
+      num_threads=64, **kw)
+  assert defaulted.num_processes == cores
+  explicit = preprocessing.MultiprocessImagePreprocessor(
+      num_processes=64, **kw)
+  assert explicit.num_processes == 64
+
+
 def test_multiprocess_preprocessor_surfaces_decode_errors(tmp_path):
   """A corrupt record must fail the parent loudly, not hang the ring."""
   from kf_benchmarks_tpu.data import example as example_lib
