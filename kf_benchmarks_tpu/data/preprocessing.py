@@ -476,8 +476,9 @@ class MultiprocessImagePreprocessor(RecordInputImagePreprocessor):
     else:
       # The DEFAULTED size is capped at the available cores: workers
       # beyond them only contend (8 workers on 1 core halved decode
-      # throughput, PERF.md round 4).
-      self.num_processes = min(max(1, self.num_threads or cores), cores)
+      # throughput, PERF.md round 4). num_threads is always >= 1
+      # (RecordInputImagePreprocessor.__init__).
+      self.num_processes = min(self.num_threads, cores)
     self.num_buffers = max(2, num_buffers)
     # Staging capacity per image slot; 256 KiB covers ~99% of ImageNet
     # JPEGs (mean ~110 KiB). Oversized records ride the inline fallback.

@@ -152,8 +152,45 @@ def _block_update_remat(q, k, v, m, l, o, scale, offsets=None,
       q, k, v, m, l, o, offsets)
 
 
+def _scan_kv_blocks(q, k, v, m, l, o, scale, block: int, offsets):
+  """Accumulate a LOCAL K/V shard in ``block``-sized sub-blocks.
+
+  The inner level of the two-level tiling inside one ring step: the
+  softmax carries stay q-sized while each score tile is (Tq, block).
+  ``offsets`` is None (unmasked) or the scalar (q_off, k_off) GLOBAL
+  offsets of q and of the K/V shard's first position; causal sub-blocks
+  strictly in the q rows' future are skipped via lax.cond.
+  """
+  b, tk, h, d = k.shape
+  if tk % block != 0:
+    raise ValueError(f"local K/V length {tk} not divisible by inner "
+                     f"block {block}")
+  nb = tk // block
+  kb = k.reshape(b, nb, block, h, d).swapaxes(0, 1)
+  vb = v.reshape(b, nb, block, h, d).swapaxes(0, 1)
+
+  def stepf(carry, inp):
+    j, kj, vj = inp
+    if offsets is None:
+      return _block_update_remat(q, kj, vj, *carry, scale, None,
+                                 prevent_cse=False), None
+    q_off, k_off = offsets
+    has_work = k_off + j * block <= q_off + q.shape[1] - 1
+    carry = lax.cond(
+        has_work,
+        lambda c: _block_update_remat(q, kj, vj, *c, scale,
+                                      (q_off, k_off + j * block),
+                                      prevent_cse=False),
+        lambda c: c, carry)
+    return carry, None
+
+  (m, l, o), _ = lax.scan(stepf, (m, l, o), (jnp.arange(nb), kb, vb))
+  return m, l, o
+
+
 def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
-                   causal: bool = False, scale: Optional[float] = None):
+                   causal: bool = False, scale: Optional[float] = None,
+                   inner_block: Optional[int] = None):
   """Blockwise ring attention inside a shard_map body.
 
   Arguments are the LOCAL sequence shards, (batch, seq/n, heads,
@@ -164,6 +201,12 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
   The n-step rotation is a Python loop: n is the static mesh-axis size,
   so the program holds n ppermute+matmul pairs XLA can pipeline --
   while-loop carries would serialize against the permute instead.
+
+  ``inner_block`` composes the single-chip two-level tiling into each
+  ring step: the local K/V shard is scanned in sub-blocks so the
+  per-device score tile is (Tq, inner_block) instead of (Tq, Tk) --
+  the multi-chip long-context memory knob (at 64k over 8 devices the
+  per-step score tile drops from 8k x 8k to 8k x inner_block).
   """
   n = lax.axis_size(axis_name)
   idx = lax.axis_index(axis_name)
@@ -192,16 +235,23 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
       # masked; skip its matmuls entirely. The predicate is per-device,
       # so the conditional runs the update only where work exists --
       # without this, (n-1)/2n of the ring's block updates would be
-      # dead FLOPs at large n. (A zigzag/striped K/V placement would
-      # balance the skip across devices; future optimisation.)
+      # dead FLOPs at large n. (The zigzag variant balances the skip
+      # across devices.)
+      if inner_block is None:
+        update = lambda ops: _block_update_remat(
+            *ops, scale, (idx * tq, src * tk))
+      else:
+        update = lambda ops: _scan_kv_blocks(
+            *ops, scale, inner_block, (idx * tq, src * tk))
       m, l, o = lax.cond(
-          src <= idx,
-          lambda ops: _block_update_remat(*ops, scale,
-                                          (idx * tq, src * tk)),
+          src <= idx, update,
           lambda ops: (ops[3], ops[4], ops[5]),
           (q, kc, vc, m, l, o))
-    else:
+    elif inner_block is None:
       m, l, o = _block_update_remat(q, kc, vc, m, l, o, scale, None)
+    else:
+      m, l, o = _scan_kv_blocks(q, kc, vc, m, l, o, scale,
+                                inner_block, None)
     if step != n - 1:
       kc = lax.ppermute(kc, axis_name, perm)
       vc = lax.ppermute(vc, axis_name, perm)
@@ -438,16 +488,26 @@ _IMPLS = {"ring": ring_attention, "ulysses": ulysses_attention}
 def make_sequence_parallel_attention(mesh: Mesh, impl: str = "ring",
                                      axis_name: str = SEQ_AXIS,
                                      causal: bool = False,
-                                     scale: Optional[float] = None):
+                                     scale: Optional[float] = None,
+                                     inner_block: Optional[int] = None):
   """Jitted attention over GLOBAL (B, L, H, D) arrays sequence-sharded
   on ``axis_name`` of ``mesh``; batch/heads stay replicated across the
-  seq axis (compose with a 'replica' batch axis for dp x sp)."""
+  seq axis (compose with a 'replica' batch axis for dp x sp).
+  ``inner_block`` (ring only) scans each ring step's local K/V in
+  sub-blocks -- the multi-chip long-context memory knob."""
   if impl not in _IMPLS:
     raise ValueError(f"impl must be one of {sorted(_IMPLS)}, got {impl!r}")
+  if inner_block is not None and impl != "ring":
+    raise ValueError("inner_block composes with impl='ring' only "
+                     f"(got {impl!r}); ulysses runs full local "
+                     "attention by design")
   fn = _IMPLS[impl]
   spec = P(None, axis_name, None, None)
 
   def body(q, k, v):
+    if impl == "ring":
+      return fn(q, k, v, axis_name=axis_name, causal=causal,
+                scale=scale, inner_block=inner_block)
     return fn(q, k, v, axis_name=axis_name, causal=causal, scale=scale)
 
   sharded = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),

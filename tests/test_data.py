@@ -188,7 +188,7 @@ def test_multiprocess_preprocessor_caps_defaulted_workers():
   cores = len(os.sched_getaffinity(0))
   kw = dict(batch_size=4, output_shape=(24, 24, 3), train=False)
   defaulted = preprocessing.MultiprocessImagePreprocessor(
-      num_threads=64, **kw)
+      num_threads=cores + 3, **kw)
   assert defaulted.num_processes == cores
   explicit = preprocessing.MultiprocessImagePreprocessor(
       num_processes=64, **kw)

@@ -176,6 +176,45 @@ def test_two_level_blockwise_matches_full(causal, q_block):
                              rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_inner_block_matches_full(causal):
+  # The two-level tiling composed INTO the ring: each ring step scans
+  # its local K/V shard in sub-blocks; result stays exact attention.
+  q, k, v = _qkv(l=64)
+  want = sequence.full_attention(q, k, v, causal=causal)
+  fn = sequence.make_sequence_parallel_attention(
+      _mesh(), impl="ring", causal=causal, inner_block=4)
+  np.testing.assert_allclose(np.asarray(fn(q, k, v)), np.asarray(want),
+                             rtol=1e-5, atol=1e-5)
+
+
+def test_ring_inner_block_gradients_match_full():
+  q, k, v = _qkv(l=64)
+  fn = sequence.make_sequence_parallel_attention(
+      _mesh(), impl="ring", causal=True, inner_block=4)
+
+  def ref_loss(q, k, v):
+    return jnp.sum(sequence.full_attention(q, k, v, causal=True) ** 2)
+
+  want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+  got = jax.grad(lambda q, k, v: jnp.sum(fn(q, k, v) ** 2),
+                 argnums=(0, 1, 2))(q, k, v)
+  for g, w in zip(got, want):
+    np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_ring_inner_block_rejections():
+  with pytest.raises(ValueError, match="ring"):
+    sequence.make_sequence_parallel_attention(
+        _mesh(), impl="ulysses", inner_block=4)
+  q, k, v = _qkv(l=64)
+  fn = sequence.make_sequence_parallel_attention(
+      _mesh(), impl="ring", inner_block=3)  # 8 local not divisible by 3
+  with pytest.raises(ValueError, match="inner"):
+    fn(q, k, v)
+
+
 def test_two_level_blockwise_gradients_match_full():
   q, k, v = _qkv(l=64)
 
