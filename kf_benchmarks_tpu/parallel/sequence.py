@@ -456,13 +456,20 @@ def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
 
 def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
                       causal: bool = False,
-                      scale: Optional[float] = None):
+                      scale: Optional[float] = None,
+                      local_block: Optional[int] = None):
   """All-to-all (Ulysses) attention inside a shard_map body.
 
   Sequence-sharded (B, L/n, H, D) inputs are re-sharded over heads --
   one tiled all_to_all each -- so every device runs full attention over
   the complete sequence for H/n heads, then the output is swapped back.
   Requires heads % axis_size == 0.
+
+  ``local_block`` replaces the O(L^2) local score tensor with the
+  blockwise (flash-style) schedule: without it, Ulysses at long L is
+  exactly the full-attention OOM the blockwise path exists to avoid
+  (the ring schedule never materialises it; this closes the same hole
+  for the all-to-all schedule).
   """
   n = lax.axis_size(axis_name)
   h = q.shape[2]
@@ -477,7 +484,12 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
                           tiled=True)
 
   qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-  out = full_attention(qh, kh, vh, causal=causal, scale=scale)
+  if local_block is None:
+    out = full_attention(qh, kh, vh, causal=causal, scale=scale)
+  else:
+    out = blockwise_attention(qh, kh, vh, block_size=local_block,
+                              causal=causal, scale=scale,
+                              q_block_size=local_block)
   return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
                         tiled=True)
 
@@ -493,14 +505,11 @@ def make_sequence_parallel_attention(mesh: Mesh, impl: str = "ring",
   """Jitted attention over GLOBAL (B, L, H, D) arrays sequence-sharded
   on ``axis_name`` of ``mesh``; batch/heads stay replicated across the
   seq axis (compose with a 'replica' batch axis for dp x sp).
-  ``inner_block`` (ring only) scans each ring step's local K/V in
-  sub-blocks -- the multi-chip long-context memory knob."""
+  ``inner_block`` is the multi-chip long-context memory knob: ring
+  scans each ring step's local K/V in sub-blocks; ulysses bounds its
+  local full-sequence step with the blockwise schedule."""
   if impl not in _IMPLS:
     raise ValueError(f"impl must be one of {sorted(_IMPLS)}, got {impl!r}")
-  if inner_block is not None and impl != "ring":
-    raise ValueError("inner_block composes with impl='ring' only "
-                     f"(got {impl!r}); ulysses runs full local "
-                     "attention by design")
   fn = _IMPLS[impl]
   spec = P(None, axis_name, None, None)
 
@@ -508,7 +517,9 @@ def make_sequence_parallel_attention(mesh: Mesh, impl: str = "ring",
     if impl == "ring":
       return fn(q, k, v, axis_name=axis_name, causal=causal,
                 scale=scale, inner_block=inner_block)
-    return fn(q, k, v, axis_name=axis_name, causal=causal, scale=scale)
+    # ulysses: the blockwise knob bounds its LOCAL full-sequence step.
+    return fn(q, k, v, axis_name=axis_name, causal=causal, scale=scale,
+              local_block=inner_block)
 
   sharded = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                           out_specs=spec)

@@ -204,15 +204,43 @@ def test_ring_inner_block_gradients_match_full():
                                rtol=1e-4, atol=1e-4)
 
 
-def test_ring_inner_block_rejections():
-  with pytest.raises(ValueError, match="ring"):
-    sequence.make_sequence_parallel_attention(
-        _mesh(), impl="ulysses", inner_block=4)
+def test_ring_inner_block_rejects_indivisible():
   q, k, v = _qkv(l=64)
   fn = sequence.make_sequence_parallel_attention(
       _mesh(), impl="ring", inner_block=3)  # 8 local not divisible by 3
   with pytest.raises(ValueError, match="inner"):
     fn(q, k, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_local_block_matches_full(causal):
+  # inner_block on the ulysses impl bounds its LOCAL full-sequence step
+  # with the blockwise schedule; the result stays exact attention.
+  q, k, v = _qkv(l=64)
+  want = sequence.full_attention(q, k, v, causal=causal)
+  fn = sequence.make_sequence_parallel_attention(
+      _mesh(), impl="ulysses", causal=causal, inner_block=16)
+  np.testing.assert_allclose(np.asarray(fn(q, k, v)), np.asarray(want),
+                             rtol=1e-5, atol=1e-5)
+
+
+def test_ulysses_local_block_gradients_match_full():
+  # The transposed all_to_all composition must backprop exactly like
+  # dense attention -- the same grad pin every other schedule knob in
+  # this file carries.
+  q, k, v = _qkv(l=64)
+  fn = sequence.make_sequence_parallel_attention(
+      _mesh(), impl="ulysses", causal=True, inner_block=16)
+
+  def ref_loss(q, k, v):
+    return jnp.sum(sequence.full_attention(q, k, v, causal=True) ** 2)
+
+  want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+  got = jax.grad(lambda q, k, v: jnp.sum(fn(q, k, v) ** 2),
+                 argnums=(0, 1, 2))(q, k, v)
+  for g, w in zip(got, want):
+    np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                               rtol=1e-4, atol=1e-4)
 
 
 def test_two_level_blockwise_gradients_match_full():
