@@ -288,7 +288,8 @@ def zigzag_inverse(seq_len: int, n: int):
 
 
 def ring_attention_zigzag(q, k, v, axis_name: str = SEQ_AXIS,
-                          scale: Optional[float] = None):
+                          scale: Optional[float] = None,
+                          inner_block: Optional[int] = None):
   """Causal ring attention over ZIGZAG-placed shards, load-balanced.
 
   Local shards are [stripe idx, stripe 2n-1-idx] of the zigzag_order
@@ -320,7 +321,20 @@ def ring_attention_zigzag(q, k, v, axis_name: str = SEQ_AXIS,
       default_axes=(axis_name,))
   acc2 = tuple(jnp.copy(x) for x in acc1)
 
-  ar = jnp.arange(t)
+  if inner_block is None:
+    upd = lambda qq, kk, vv, acc, offs: _block_update_remat(
+        qq, kk, vv, *acc, scale, offs)
+  else:
+    # Stripe-sized tiles shrink to (t, inner_block) -- the same knob as
+    # the contiguous ring's, but dividing the STRIPE length t (= local
+    # shard / 2), not the shard length.
+    if t % inner_block != 0:
+      raise ValueError(
+          f"zigzag inner_block must divide the stripe length {t} "
+          f"(= local shard {tq2} / 2), got {inner_block}")
+    upd = lambda qq, kk, vv, acc, offs: _scan_kv_blocks(
+        qq, kk, vv, *acc, scale, inner_block, offs)
+
   kc, vc = k, v
   perm = [(i, (i + 1) % n) for i in range(n)]
   for step in range(n):
@@ -334,14 +348,13 @@ def ring_attention_zigzag(q, k, v, axis_name: str = SEQ_AXIS,
     # device-varying stripe comparison (diagonal => triangular mask).
     acc1 = lax.cond(
         idx >= src,
-        lambda ops: _block_update_remat(q1, k1, v1, *ops, scale,
-                                        (idx * t, src * t)),
+        lambda ops: upd(q1, k1, v1, ops, (idx * t, src * t)),
         lambda ops: ops, acc1)
-    acc2 = _block_update_remat(q2, k1, v1, *acc2, scale, None)
+    acc2 = upd(q2, k1, v1, acc2, None)
     acc2 = lax.cond(
         src >= idx,
-        lambda ops: _block_update_remat(q2, k2, v2, *ops, scale,
-                                        ((z - idx) * t, (z - src) * t)),
+        lambda ops: upd(q2, k2, v2, ops,
+                        ((z - idx) * t, (z - src) * t)),
         lambda ops: ops, acc2)
     if step != n - 1:
       kc = lax.ppermute(kc, axis_name, perm)
@@ -527,7 +540,8 @@ def make_sequence_parallel_attention(mesh: Mesh, impl: str = "ring",
 
 
 def make_zigzag_attention(mesh: Mesh, axis_name: str = SEQ_AXIS,
-                          scale: Optional[float] = None):
+                          scale: Optional[float] = None,
+                          inner_block: Optional[int] = None):
   """Jitted load-balanced causal ring attention over GLOBAL (B, L, H,
   D) arrays in NORMAL sequence order.
 
@@ -542,7 +556,7 @@ def make_zigzag_attention(mesh: Mesh, axis_name: str = SEQ_AXIS,
 
   def body(q, k, v):
     return ring_attention_zigzag(q, k, v, axis_name=axis_name,
-                                 scale=scale)
+                                 scale=scale, inner_block=inner_block)
 
   sharded = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                           out_specs=spec)

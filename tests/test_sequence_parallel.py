@@ -121,6 +121,25 @@ def test_zigzag_ring_gradients_match_full_attention():
                                rtol=1e-4, atol=1e-4)
 
 
+def test_zigzag_inner_block_matches_full():
+  # The K/V sub-block tiling composed into the zigzag ring: stripes
+  # scan their travelling K/V in tiles, result stays exact causal
+  # attention in normal order.
+  q, k, v = _qkv(l=64)
+  want = sequence.full_attention(q, k, v, causal=True)
+  fn = sequence.make_zigzag_attention(_mesh(), inner_block=2)
+  np.testing.assert_allclose(np.asarray(fn(q, k, v)), np.asarray(want),
+                             rtol=1e-5, atol=1e-5)
+  g = jax.grad(lambda q, k, v: jnp.sum(fn(q, k, v) ** 2),
+               argnums=(0, 1, 2))(q, k, v)
+  w = jax.grad(lambda q, k, v: jnp.sum(
+      sequence.full_attention(q, k, v, causal=True) ** 2),
+      argnums=(0, 1, 2))(q, k, v)
+  for a, b in zip(g, w):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-4, atol=1e-4)
+
+
 def test_zigzag_rejects_indivisible_length():
   with pytest.raises(ValueError, match="not divisible"):
     sequence.zigzag_order(30, 8)
