@@ -151,12 +151,15 @@ def _embed_positions(params, tokens, *, seq_axis, sp_layout):
   return x + lax.dynamic_slice_in_dim(params["pos"], pos0, t, axis=0)
 
 
-def _attention_residual(lp, x, *, seq_axis, tensor_axis, sp_layout):
+def _attention_residual(lp, x, *, seq_axis, tensor_axis, sp_layout,
+                        attn_inner_block=None):
   """ln -> qkv -> (ring|zigzag) attention -> output proj residual.
 
   Returns (x_new, h) where h is the post-attention rmsnorm the MLP/MoE
   half of the block consumes -- shared by the flat and the pipelined
-  forward paths.
+  forward paths. ``attn_inner_block`` is the ring schedules' K/V
+  sub-block tiling knob (sequence.py): long-context memory control for
+  the composed trainer.
   """
   b, t, _ = x.shape
   d_model = lp["wqkv"].shape[0]
@@ -167,11 +170,13 @@ def _attention_residual(lp, x, *, seq_axis, tensor_axis, sp_layout):
   qkv = qkv.reshape(b, t, 3, heads_local, head_dim)
   if sp_layout == "zigzag":
     att = seq_lib.ring_attention_zigzag(
-        qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], axis_name=seq_axis)
+        qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], axis_name=seq_axis,
+        inner_block=attn_inner_block)
   else:
     att = seq_lib.ring_attention(
         qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
-        axis_name=seq_axis, causal=True)
+        axis_name=seq_axis, causal=True,
+        inner_block=attn_inner_block)
   x = x + tp_lib.row_parallel_dense(
       att.reshape(b, t, heads_local * head_dim),
       lp["wo"].reshape(heads_local * head_dim, d_model),
@@ -181,7 +186,8 @@ def _attention_residual(lp, x, *, seq_axis, tensor_axis, sp_layout):
 
 def forward_local(params, tokens, *, seq_axis=SEQ_AXIS,
                   tensor_axis=TENSOR_AXIS, expert_axis=REPLICA_AXIS,
-                  moe_capacity=None, sp_layout: str = "contiguous"):
+                  moe_capacity=None, sp_layout: str = "contiguous",
+                  attn_inner_block=None):
   """Per-shard forward: tokens (B_local, T_local) -> (logits, moe_aux).
 
   Runs inside a shard_map body; params are the LOCAL shards
@@ -202,7 +208,8 @@ def forward_local(params, tokens, *, seq_axis=SEQ_AXIS,
     d_model = lp["wqkv"].shape[0]
     x, h = _attention_residual(lp, x, seq_axis=seq_axis,
                                tensor_axis=tensor_axis,
-                               sp_layout=sp_layout)
+                               sp_layout=sp_layout,
+                               attn_inner_block=attn_inner_block)
     if "gate_w" in lp:
       cap = (b * t) if moe_capacity is None else moe_capacity
       y, aux = ep_lib.switch_moe(
@@ -336,7 +343,8 @@ def build_mesh(n_replica: int, n_seq: int, n_tensor: int,
 
 def make_train_step(mesh: Mesh, params_template, learning_rate: float,
                     moe_capacity=None, moe_aux_weight: float = 0.01,
-                    sp_layout: str = "contiguous"):
+                    sp_layout: str = "contiguous",
+                    attn_inner_block=None):
   """Jitted SGD train step over GLOBAL (params, tokens, labels):
   tokens/labels (batch, seq) in NORMAL order, sharded (replica, seq);
   params per param_specs. MoE blocks (if any in the template) add
@@ -356,9 +364,9 @@ def make_train_step(mesh: Mesh, params_template, learning_rate: float,
 
   def body(params, tokens, labels):
     def local_loss(p):
-      logits, moe_aux = forward_local(p, tokens,
-                                      moe_capacity=moe_capacity,
-                                      sp_layout=sp_layout)
+      logits, moe_aux = forward_local(
+          p, tokens, moe_capacity=moe_capacity, sp_layout=sp_layout,
+          attn_inner_block=attn_inner_block)
       return (_loss_from_logits(logits, labels)
               + moe_aux_weight * moe_aux)
 
@@ -463,7 +471,8 @@ def pipelined_param_specs():
 def forward_local_pipelined(params, tokens, *, num_microbatches: int,
                             seq_axis=SEQ_AXIS, tensor_axis=TENSOR_AXIS,
                             stage_axis=STAGE_AXIS,
-                            sp_layout: str = "contiguous"):
+                            sp_layout: str = "contiguous",
+                            attn_inner_block=None):
   """Per-shard forward with the layer stack sharded over the stage
   axis: embed/positions everywhere (stage-replicated), the GPipe scan
   (parallel/pipeline.py) carries activations stage-to-stage via
@@ -489,7 +498,8 @@ def forward_local_pipelined(params, tokens, *, num_microbatches: int,
       lp = jax.tree.map(lambda a: a[i], p)
       xm, h = _attention_residual(lp, xm, seq_axis=seq_axis,
                                   tensor_axis=tensor_axis,
-                                  sp_layout=sp_layout)
+                                  sp_layout=sp_layout,
+                                  attn_inner_block=attn_inner_block)
       xm = xm + tp_lib.parallel_mlp(h, lp["w1"], lp["b1"], lp["w2"],
                                     lp["b2"], axis_name=tensor_axis)
     return xm
@@ -511,7 +521,8 @@ def build_mesh_pp(n_replica: int, n_stage: int, n_seq: int,
 def make_pipelined_train_step(mesh: Mesh, pparams_template,
                               learning_rate: float,
                               num_microbatches: int,
-                              sp_layout: str = "contiguous"):
+                              sp_layout: str = "contiguous",
+                              attn_inner_block=None):
   """Jitted SGD step over the 4-D (replica, stage, seq, tensor) mesh.
 
   pparams_template is a to_pipelined() tree; tokens/labels are GLOBAL
@@ -533,7 +544,7 @@ def make_pipelined_train_step(mesh: Mesh, pparams_template,
     def local_loss(p):
       logits = forward_local_pipelined(
           p, tokens, num_microbatches=num_microbatches,
-          sp_layout=sp_layout)
+          sp_layout=sp_layout, attn_inner_block=attn_inner_block)
       return _loss_from_logits(logits, labels)
 
     loss, grads = jax.value_and_grad(local_loss)(params)

@@ -261,6 +261,29 @@ def test_pipelined_rejects_stage_mesh_mismatch():
     step(wrong, tokens, labels)
 
 
+@pytest.mark.parametrize("sp_layout", ["contiguous", "zigzag"])
+def test_attn_inner_block_matches_single_device(sp_layout):
+  # The ring schedules' K/V sub-block tiling, reachable from the
+  # composed trainer in both sequence layouts (zigzag's divisibility is
+  # against the stripe length = local shard / 2): numerics must not
+  # move.
+  params, tokens, labels = _setup(seed=51)
+  mesh = transformer.build_mesh(2, 2, 2)
+  step = transformer.make_train_step(mesh, params, learning_rate=0.1,
+                                     attn_inner_block=2,
+                                     sp_layout=sp_layout)
+  want_loss, ref_grads = jax.value_and_grad(
+      transformer.reference_loss)(params, tokens, labels)
+  ref_new = jax.tree.map(lambda p, g: p - 0.1 * g, params, ref_grads)
+  got_new, got_loss = step(jax.tree.map(jnp.copy, params), tokens,
+                           labels)
+  np.testing.assert_allclose(float(got_loss), float(want_loss),
+                             rtol=1e-5, atol=1e-6)
+  for g, w in zip(jax.tree.leaves(got_new), jax.tree.leaves(ref_new)):
+    np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_alternate_mesh_shapes():
   # Degenerate axes must work too: pure-sp (1, 8, 1) and pure-tp
   # (1, 1, 4) meshes run the same program.
