@@ -1,0 +1,57 @@
+#!/bin/bash
+# Round-5 hardware evidence capture, in VERDICT priority order, fully
+# serialized (ONE TPU client at a time -- CLAUDE.md), with NO
+# kill-based timeouts anywhere: a timeout kill mid-claim/compile is
+# the tunnel-wedge trigger (round-4 incident). Run only in a window
+# where `python -c "import jax; print(jax.devices())"` succeeds.
+#
+#   bash experiments/run_r5_hardware.sh [outdir]
+#
+# Stages (safe/cached compiles first, the novel big compile LAST):
+#   1. bench.py                      -- the driver-verifiable headline
+#   2. texture convergence tier      -- resnet20, known-fast compile
+#   3. zoo rows missing from r4      -- nasnet/densenet/lenet/trivial/
+#                                       official_resnet
+#   4. serving sweep incl. aot-int8  -- resnet50 forward/AOT/INT8
+#   5. long-context before/after     -- blockwise vs tiled, B in {1,4}
+#   6. transformer_lm throughput     -- the NOVEL compile (>=60 min
+#                                       budget, nothing else running)
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-experiments/r5_hw}
+mkdir -p "$OUT"
+log() { echo "$(date -u +%H:%M:%S) $*" | tee -a "$OUT/driver.log"; }
+
+log "stage 1: bench.py"
+python bench.py > "$OUT/bench.out" 2> "$OUT/bench.err"
+log "bench: $(cat "$OUT/bench.out")"
+
+log "stage 2: texture convergence (KF_TPU_TESTS=1)"
+KF_TPU_TESTS=1 python -m pytest tests/test_tpu_convergence.py -q \
+  > "$OUT/convergence.out" 2>&1
+log "convergence rc=$? (artifacts in experiments/*.log)"
+
+log "stage 3: missing zoo rows"
+python experiments/zoo_sweep.py \
+  --only nasnet densenet40_k12 lenet trivial official_resnet18 \
+  > "$OUT/zoo.out" 2>&1
+log "zoo rc=$?"
+
+log "stage 4: serving sweep (forward/aot/aot-int8)"
+python experiments/serving_sweep.py --bs 64 256 --batches 30 \
+  > "$OUT/serving.out" 2>&1
+log "serving rc=$?"
+
+log "stage 5: long-context blockwise vs tiled"
+python experiments/long_context_probe.py \
+  --impls blockwise tiled --lengths 8192 32768 65536 --batch 1 4 \
+  > "$OUT/longcontext.out" 2>&1
+log "longcontext rc=$?"
+
+log "stage 6 (LAST, novel compile, no timeout): transformer_lm bs4"
+python -m kf_benchmarks_tpu.cli --model=transformer_lm --batch_size=4 \
+  --use_fp16=true --num_batches=30 --num_warmup_batches=3 \
+  --display_every=5 --variable_update=replicated \
+  > "$OUT/transformer_lm.out" 2>&1
+log "transformer_lm rc=$?"
+log "done; outputs in $OUT"
