@@ -51,6 +51,23 @@ def make_rep(impl, l, dtype, block=BLOCK, batch=1, q_block=None):
     attn = lambda q, k, v: sequence.blockwise_attention(
         q, k, v, block_size=block, causal=True,
         q_block_size=block if q_block is None else q_block)
+  elif impl == "flash":
+    # The hand-tiled Pallas kernel (TPU-only) -- measures what XLA's
+    # scan lowering leaves on the table, if anything. --block sets the
+    # kernel's q/k tiles so the A/B against tiled/blockwise compares
+    # matched tilings.
+    from jax.experimental.pallas.ops.tpu import flash_attention as fa
+    bs = fa.BlockSizes(block_q=min(block, l), block_k_major=min(block, l),
+                       block_k=min(block, l), block_b=1,
+                       block_q_major_dkv=min(block, l),
+                       block_k_major_dkv=min(block, l),
+                       block_k_dkv=min(block, l),
+                       block_q_dkv=min(block, l),
+                       block_k_major_dq=min(block, l),
+                       block_k_dq=min(block, l),
+                       block_q_dq=min(block, l))
+    attn = lambda q, k, v: sequence.pallas_flash_attention(
+        q, k, v, causal=True, block_sizes=bs)
   else:
     attn = lambda q, k, v: sequence.blockwise_attention(
         q, k, v, block_size=block, causal=True)
@@ -110,7 +127,7 @@ def main():
   ap.add_argument("--q_block", type=int, default=None)
   ap.add_argument("--batch", type=int, nargs="+", default=[1])
   ap.add_argument("--impls", nargs="+",
-                  choices=["full", "blockwise", "tiled"],
+                  choices=["full", "blockwise", "tiled", "flash"],
                   default=["full", "blockwise", "tiled"])
   args = ap.parse_args()
   dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32

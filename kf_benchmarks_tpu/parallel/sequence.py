@@ -507,6 +507,28 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
                         tiled=True)
 
 
+def pallas_flash_attention(q, k, v, causal: bool = False,
+                           scale: Optional[float] = None,
+                           block_sizes=None):
+  """JAX's TPU Pallas flash-attention kernel behind this module's
+  (B, L, H, D) layout -- the hand-tiled alternative to the XLA-scan
+  blockwise schedule, for A/B measurement on hardware
+  (experiments/long_context_probe.py --impls flash).
+
+  TPU-only: the kernel (jax.experimental.pallas.ops.tpu.
+  flash_attention) has no CPU lowering, so CPU suites exercise only
+  the layout plumbing. Differentiable -- the library ships fused
+  dq/dkv backward kernels via custom_vjp.
+  """
+  from jax.experimental.pallas.ops.tpu import flash_attention as fa
+  d = q.shape[-1]
+  scale = (1.0 / math.sqrt(d)) if scale is None else scale
+  qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
+  out = fa.flash_attention(qt, kt, vt, causal=causal, sm_scale=scale,
+                           block_sizes=block_sizes)
+  return out.swapaxes(1, 2).astype(q.dtype)
+
+
 _IMPLS = {"ring": ring_attention, "ulysses": ulysses_attention}
 
 

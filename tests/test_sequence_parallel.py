@@ -7,6 +7,8 @@ reference implementation on the 8-device virtual mesh (conftest.py),
 forward AND backward.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -260,6 +262,44 @@ def test_ulysses_local_block_gradients_match_full():
   for g, w in zip(got, want):
     np.testing.assert_allclose(np.asarray(g), np.asarray(w),
                                rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(os.environ.get("KF_TPU_TESTS") != "1",
+                    reason="Pallas flash kernel is TPU-only; opt-in "
+                           "with KF_TPU_TESTS=1 (serialize TPU work)")
+def test_pallas_flash_matches_full_on_tpu():
+  # The hand-tiled kernel vs dense attention, forward and backward, on
+  # the real chip (the CPU suite exercises only the layout wrapper).
+  import subprocess
+  import sys
+  repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+  prog = r"""
+import jax, jax.numpy as jnp, numpy as np
+from kf_benchmarks_tpu.parallel import sequence
+key = jax.random.PRNGKey(0)
+q, k, v = (jax.random.normal(jax.random.fold_in(key, i),
+                             (1, 1024, 8, 128), jnp.float32)
+           for i in range(3))
+want = sequence.full_attention(q, k, v, causal=True)
+got = sequence.pallas_flash_attention(q, k, v, causal=True)
+np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                           rtol=2e-2, atol=2e-2)
+gw = jax.grad(lambda q: jnp.sum(
+    sequence.full_attention(q, k, v, causal=True) ** 2))(q)
+gg = jax.grad(lambda q: jnp.sum(
+    sequence.pallas_flash_attention(q, k, v, causal=True) ** 2))(q)
+np.testing.assert_allclose(np.asarray(gg), np.asarray(gw),
+                           rtol=5e-2, atol=5e-2)
+print("FLASH_OK")
+"""
+  env = dict(os.environ)
+  env.pop("XLA_FLAGS", None)
+  env.pop("JAX_PLATFORMS", None)
+  r = subprocess.run([sys.executable, "-c", prog], capture_output=True,
+                     text=True, timeout=3600, env=env, cwd=repo)
+  assert r.returncode == 0 and "FLASH_OK" in r.stdout, (
+      r.stdout[-2000:], r.stderr[-2000:])
 
 
 def test_two_level_blockwise_gradients_match_full():
