@@ -42,9 +42,9 @@ python experiments/serving_sweep.py --bs 64 256 --batches 30 \
   > "$OUT/serving.out" 2>&1
 log "serving rc=$?"
 
-log "stage 5: long-context blockwise vs tiled"
+log "stage 5: long-context blockwise vs tiled vs pallas flash"
 python experiments/long_context_probe.py \
-  --impls blockwise tiled --lengths 8192 32768 65536 --batch 1 4 \
+  --impls blockwise tiled flash --lengths 8192 32768 65536 --batch 1 4 \
   > "$OUT/longcontext.out" 2>&1
 log "longcontext rc=$?"
 
