@@ -13,9 +13,17 @@
 #   3. zoo rows missing from r4      -- nasnet/densenet/lenet/trivial/
 #                                       official_resnet
 #   4. serving sweep incl. aot-int8  -- resnet50 forward/AOT/INT8
-#   5. long-context before/after     -- blockwise vs tiled, B in {1,4}
-#   6. transformer_lm throughput     -- the NOVEL compile (>=60 min
-#                                       budget, nothing else running)
+#   5. long-context before/after     -- blockwise vs tiled vs pallas
+#                                       flash, B in {1,4}. The flash
+#                                       arm is itself a FIRST Pallas
+#                                       compile over the tunnel --
+#                                       small attention-only programs
+#                                       (minutes, not the 30-min
+#                                       whole-model class), but if it
+#                                       stalls, let it run to exit.
+#   6. transformer_lm throughput     -- the NOVEL whole-model compile
+#                                       (>=60 min budget, nothing else
+#                                       running)
 set -u
 cd "$(dirname "$0")/.."
 OUT=${1:-experiments/r5_hw}

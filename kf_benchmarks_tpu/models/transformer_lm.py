@@ -49,6 +49,9 @@ class _TransformerLMModule(nn.Module):
   d_ff: int = D_FF
   attn_block: int = ATTN_BLOCK
   attn_q_block: int = ATTN_Q_BLOCK
+  # 'tiled' (XLA two-level scan) or 'flash' (the TPU Pallas kernel) --
+  # switchable per run via KF_TRANSFORMER_LM_ATTN for on-chip A/Bs.
+  attn_impl: str = "tiled"
   max_len: int = SEQ_LEN
   dtype: Any = jnp.float32
   param_dtype: Any = jnp.float32
@@ -78,10 +81,30 @@ class _TransformerLMModule(nn.Module):
       h = ln(f"ln1_{i}")(x).astype(self.dtype)
       qkv = dense(3 * self.d_model, f"qkv_{i}", bias=False)(h)
       qkv = qkv.reshape(b, t, 3, self.n_heads, head_dim)
-      att = sequence_lib.blockwise_attention(
-          qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
-          block_size=min(self.attn_block, t), causal=True,
-          q_block_size=min(self.attn_q_block, t))
+      blk = min(self.attn_block, t)
+      if self.attn_impl == "flash":
+        # Matched tilings: the A/B against the tiled path must not
+        # confound kernel choice with tile size, so the kernel gets
+        # the same block as the scan (long_context_probe.py ditto).
+        from jax.experimental.pallas.ops.tpu import (
+            flash_attention as fa)
+        bs = fa.BlockSizes(
+            block_q=blk, block_k_major=blk, block_k=blk, block_b=1,
+            block_q_major_dkv=blk, block_k_major_dkv=blk,
+            block_k_dkv=blk, block_q_dkv=blk, block_k_major_dq=blk,
+            block_k_dq=blk, block_q_dq=blk)
+        att = sequence_lib.pallas_flash_attention(
+            qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], causal=True,
+            block_sizes=bs)
+      elif self.attn_impl == "tiled":
+        att = sequence_lib.blockwise_attention(
+            qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+            block_size=blk, causal=True,
+            q_block_size=min(self.attn_q_block, t))
+      else:
+        raise ValueError(
+            f"attn_impl must be 'tiled' or 'flash', got "
+            f"{self.attn_impl!r}")
       x = x + dense(self.d_model, f"attn_out_{i}")(
           att.reshape(b, t, self.d_model))
       h = ln(f"ln2_{i}")(x).astype(self.dtype)
@@ -109,7 +132,14 @@ class TransformerLMModel(model_lib.Model):
   def make_module(self, nclass, phase_train, data_format="NHWC",
                   dtype=jnp.float32, param_dtype=jnp.float32):
     del nclass, phase_train, data_format
-    return _TransformerLMModule(dtype=dtype, param_dtype=param_dtype)
+    import os
+    impl = os.environ.get("KF_TRANSFORMER_LM_ATTN", "tiled")
+    if impl not in ("tiled", "flash"):
+      raise ValueError(
+          f"KF_TRANSFORMER_LM_ATTN must be 'tiled' or 'flash', got "
+          f"{impl!r}")
+    return _TransformerLMModule(dtype=dtype, param_dtype=param_dtype,
+                                attn_impl=impl)
 
   def get_input_shapes(self, subset):
     n = self.get_batch_size()
