@@ -55,19 +55,9 @@ def make_rep(impl, l, dtype, block=BLOCK, batch=1, q_block=None):
     # The hand-tiled Pallas kernel (TPU-only) -- measures what XLA's
     # scan lowering leaves on the table, if anything. --block sets the
     # kernel's q/k tiles so the A/B against tiled/blockwise compares
-    # matched tilings.
-    from jax.experimental.pallas.ops.tpu import flash_attention as fa
-    bs = fa.BlockSizes(block_q=min(block, l), block_k_major=min(block, l),
-                       block_k=min(block, l), block_b=1,
-                       block_q_major_dkv=min(block, l),
-                       block_k_major_dkv=min(block, l),
-                       block_k_dkv=min(block, l),
-                       block_q_dkv=min(block, l),
-                       block_k_major_dq=min(block, l),
-                       block_k_dq=min(block, l),
-                       block_q_dq=min(block, l))
+    # matched tilings (one shared BlockSizes builder in sequence.py).
     attn = lambda q, k, v: sequence.pallas_flash_attention(
-        q, k, v, causal=True, block_sizes=bs)
+        q, k, v, causal=True, block=block)
   else:
     attn = lambda q, k, v: sequence.blockwise_attention(
         q, k, v, block_size=block, causal=True)

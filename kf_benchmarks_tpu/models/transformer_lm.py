@@ -86,16 +86,9 @@ class _TransformerLMModule(nn.Module):
         # Matched tilings: the A/B against the tiled path must not
         # confound kernel choice with tile size, so the kernel gets
         # the same block as the scan (long_context_probe.py ditto).
-        from jax.experimental.pallas.ops.tpu import (
-            flash_attention as fa)
-        bs = fa.BlockSizes(
-            block_q=blk, block_k_major=blk, block_k=blk, block_b=1,
-            block_q_major_dkv=blk, block_k_major_dkv=blk,
-            block_k_dkv=blk, block_q_dkv=blk, block_k_major_dq=blk,
-            block_k_dq=blk, block_q_dq=blk)
         att = sequence_lib.pallas_flash_attention(
             qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], causal=True,
-            block_sizes=bs)
+            block=blk)
       elif self.attn_impl == "tiled":
         att = sequence_lib.blockwise_attention(
             qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],

@@ -100,9 +100,19 @@ def _block_update(q, k, v, m, l, o, scale, mask):
 
   q: (B,Tq,H,D); k,v: (B,Tk,H,D); running max m and denominator l:
   (B,H,Tq); running unnormalised output o: (B,Tq,H,D) float32.
+
+  MXU-native mixed precision: the matmul MULTIPLICANDS stay in the
+  input dtype (bf16 on TPU runs at full MXU rate) and only the
+  ACCUMULATION is f32, via preferred_element_type -- upcasting the
+  inputs to f32 first would force f32 matmuls at a fraction of peak
+  (the signature of the round-4 ~29 TFLOP/s long-context measurement).
+  The probability tile is cast to v's dtype for the PV matmul, the
+  standard flash-attention precision class; softmax statistics (max,
+  exp, denominators) remain f32 throughout. With f32 inputs every step
+  is bit-identical to the previous all-f32 form.
   """
-  s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                 k.astype(jnp.float32)) * scale
+  s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                 preferred_element_type=jnp.float32) * scale
   if mask is not None:
     s = jnp.where(mask, s, _NEG)
   m_new = jnp.maximum(m, jnp.max(s, axis=-1))
@@ -113,7 +123,8 @@ def _block_update(q, k, v, m, l, o, scale, mask):
     # zero those entries so they never enter l or o.
     p = jnp.where(mask, p, 0.0)
   l_new = l * corr + jnp.sum(p, axis=-1)
-  pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+  pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                  preferred_element_type=jnp.float32)
   o_new = o * corr.swapaxes(1, 2)[..., None] + pv
   return m_new, l_new, o_new
 
@@ -507,9 +518,21 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
                         tiled=True)
 
 
+def uniform_flash_block_sizes(block: int):
+  """All-fields-equal BlockSizes for the Pallas kernel -- ONE place to
+  build 'matched tiling' configurations, so A/Bs against the XLA-scan
+  paths cannot silently diverge between call sites."""
+  from jax.experimental.pallas.ops.tpu import flash_attention as fa
+  return fa.BlockSizes(
+      block_q=block, block_k_major=block, block_k=block, block_b=1,
+      block_q_major_dkv=block, block_k_major_dkv=block,
+      block_k_dkv=block, block_q_dkv=block, block_k_major_dq=block,
+      block_k_dq=block, block_q_dq=block)
+
+
 def pallas_flash_attention(q, k, v, causal: bool = False,
                            scale: Optional[float] = None,
-                           block_sizes=None):
+                           block_sizes=None, block: Optional[int] = None):
   """JAX's TPU Pallas flash-attention kernel behind this module's
   (B, L, H, D) layout -- the hand-tiled alternative to the XLA-scan
   blockwise schedule, for A/B measurement on hardware
@@ -521,6 +544,10 @@ def pallas_flash_attention(q, k, v, causal: bool = False,
   dq/dkv backward kernels via custom_vjp.
   """
   from jax.experimental.pallas.ops.tpu import flash_attention as fa
+  if block is not None:
+    if block_sizes is not None:
+      raise ValueError("pass block OR block_sizes, not both")
+    block_sizes = uniform_flash_block_sizes(min(block, q.shape[1]))
   d = q.shape[-1]
   scale = (1.0 / math.sqrt(d)) if scale is None else scale
   qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))

@@ -174,6 +174,22 @@ def test_blockwise_attention_gradients_match_full():
                                rtol=1e-4, atol=1e-4)
 
 
+def test_blockwise_bf16_stays_close_to_f32_reference():
+  # The MXU-native precision class (bf16 multiplicands, f32
+  # accumulation/softmax stats) must stay within bf16 rounding of the
+  # exact f32 computation -- and the f32 path itself is bit-compatible
+  # with the old upcast-everything form (pinned by the exact-equality
+  # tests above running in f32).
+  q, k, v = _qkv(l=64)
+  want = sequence.full_attention(q, k, v, causal=True)
+  got = sequence.blockwise_attention(
+      q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+      v.astype(jnp.bfloat16), block_size=16, causal=True,
+      q_block_size=16)
+  np.testing.assert_allclose(np.asarray(got, np.float32),
+                             np.asarray(want), rtol=5e-2, atol=5e-2)
+
+
 def test_blockwise_rejects_indivisible_length():
   q, k, v = _qkv(l=32)
   with pytest.raises(ValueError, match="not divisible"):

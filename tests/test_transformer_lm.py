@@ -47,6 +47,31 @@ def test_module_shapes_and_loss():
   assert 0.0 <= float(acc["top_1_accuracy"]) <= 1.0
 
 
+def test_flash_branch_traces_on_cpu():
+  # The Pallas kernel only RUNS on TPU, but the flash-configured module
+  # must TRACE on CPU (eval_shape) -- a jax upgrade drifting the
+  # BlockSizes fields or layout plumbing should fail the CPU suite, not
+  # the one-shot serialized hardware window.
+  vocab, t = 128, 512
+  module = transformer_lm._TransformerLMModule(
+      vocab=vocab, d_model=512, n_layers=1, n_heads=8,
+      attn_block=256, max_len=t, attn_impl="flash")
+  tokens = jnp.zeros((1, t), jnp.int32)
+  variables = jax.eval_shape(
+      lambda: module.init({"params": jax.random.PRNGKey(0)}, tokens))
+  out = jax.eval_shape(
+      lambda v: module.apply(v, tokens)[0], variables)
+  assert out.shape == (1, t, vocab)
+
+
+def test_make_module_rejects_unknown_attn_impl(monkeypatch):
+  monkeypatch.setenv("KF_TRANSFORMER_LM_ATTN", "bogus")
+  model = model_config.get_model_config("transformer_lm", "synthetic")
+  import pytest
+  with pytest.raises(ValueError, match="tiled.*flash"):
+    model.make_module(nclass=10, phase_train=True)
+
+
 def test_chunked_loss_matches_unchunked():
   from kf_benchmarks_tpu.models.model import BuildNetworkResult
   model = model_config.get_model_config("transformer_lm", "synthetic")
