@@ -41,16 +41,16 @@ def export_forward(model, variables, batch_size: int, path: str,
 
   if quantize:
     from kf_benchmarks_tpu import quantization
-    qvars = quantization.quantize_variables(variables)
+    variables = quantization.quantize_variables(variables)
 
-    def frozen_forward(images):
-      fvars = quantization.dequantize_variables(qvars, jnp.float32)
-      logits, _ = module.apply(fvars, images)
-      return logits
-  else:
-    def frozen_forward(images):
-      logits, _ = module.apply(variables, images)
-      return logits
+  def frozen_forward(images):
+    if quantize:
+      from kf_benchmarks_tpu import quantization
+      fvars = quantization.dequantize_variables(variables, jnp.float32)
+    else:
+      fvars = variables
+    logits, _ = module.apply(fvars, images)
+    return logits
 
   image_shape = tuple(model.get_input_shapes("eval")[0])
   spec = jax.ShapeDtypeStruct(image_shape, jnp.float32)

@@ -85,8 +85,7 @@ def quantized_fraction(qvars) -> float:
   for logs/tests (a model whose kernels all fell under the size
   threshold serves no quantization purpose)."""
   q_elems = total = 0
-  for leaf in jax.tree.leaves(
-      qvars, is_leaf=lambda x: _is_qleaf(x)):
+  for leaf in jax.tree.leaves(qvars, is_leaf=_is_qleaf):
     if _is_qleaf(leaf):
       q_elems += leaf[_QKEY].size
       total += leaf[_QKEY].size
