@@ -42,7 +42,10 @@ ZOO = [
     ("mobilenet", 256, []),
     # The round-4 table's five gaps (VERDICT r4 missing #4): every
     # registered family gets a measured row.
-    ("nasnet", 128, ["--data_name=cifar10"]),
+    # nasnet keeps its model-default batch (32): the cifar cell stack
+    # carries aux heads + drop-path, and a one-shot hardware window is
+    # not the place to discover its bs-128 memory envelope.
+    ("nasnet", 32, ["--data_name=cifar10"]),
     ("densenet40_k12", 256, ["--data_name=cifar10"]),
     ("lenet", 512, []),
     ("trivial", 512, []),
