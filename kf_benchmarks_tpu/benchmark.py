@@ -299,6 +299,18 @@ class BenchmarkCNN:
     self.mesh = mesh_lib.build_mesh(self.num_devices, params.device)
     self.strategy = strategies.get_strategy(params)
     self.num_batches = self._get_num_batches()
+    # Device-resident multi-step dispatch (--steps_per_dispatch=K): K
+    # train steps per compiled program (train_step.py train_chunk), so
+    # dispatch + tunnel RTT amortize K-fold. A run shorter than one
+    # chunk scans the whole run in a single dispatch. Validation has
+    # already rejected K > 1 with --eval/--forward_only.
+    spd = max(1, params.steps_per_dispatch or 1)
+    if spd > self.num_batches:
+      spd = max(1, self.num_batches)
+    if spd != (params.steps_per_dispatch or 1):
+      params = params._replace(steps_per_dispatch=spd)
+      self.params = params
+    self.steps_per_dispatch = spd
     self.eval_step_set = compute_eval_step_set(
         params, self.batch_size * max(self.num_workers, 1),
         self.dataset.num_examples_per_epoch("train"), self.num_batches)
@@ -412,7 +424,7 @@ class BenchmarkCNN:
     return mesh_lib.put_batch(
         (tile(images), jax.tree.map(tile, labels)), batch_sharding)
 
-  def _input_iterator(self, rng, subset: str = "train"):
+  def _input_iterator(self, rng, subset: str = "train", chunk: int = 1):
     """Per-step input source.
 
     Synthetic (no data_dir): one device-resident batch reused every step
@@ -420,9 +432,18 @@ class BenchmarkCNN:
     pipeline + double-buffered DeviceFeeder (the StagingArea/
     MultiDeviceIterator analog, ref: benchmark_cnn.py:2572-2600).
     Returns (next_fn, stop_fn).
+
+    ``chunk`` > 1 stages --steps_per_dispatch batches per fetch: real
+    data arrives as one (chunk, batch, ...) staged array; synthetic
+    arrives with a leading axis of 1 (the scanned program reuses the
+    resident batch, so no K-wide staging footprint exists at all).
     """
     if self.dataset.use_synthetic_gpu_inputs():
       batch = self._synthetic_global_batch(rng)
+      if chunk > 1:
+        chunk_sharding = mesh_lib.chunk_batch_sharding(self.mesh)
+        batch = jax.tree.map(
+            lambda x: jax.device_put(x[None], chunk_sharding), batch)
       return (lambda: batch), (lambda: None)
     from kf_benchmarks_tpu.data import device_feed
     p = self.params
@@ -457,8 +478,10 @@ class BenchmarkCNN:
     if self.compute_dtype != jnp.float32:
       host_iter = self._cast_images(host_iter)
     feeder = device_feed.DeviceFeeder(
-        host_iter, mesh_lib.batch_sharding(self.mesh),
-        prefetch=feeder_prefetch(p))
+        host_iter,
+        mesh_lib.chunk_batch_sharding(self.mesh) if chunk > 1
+        else mesh_lib.batch_sharding(self.mesh),
+        prefetch=max(feeder_prefetch(p), chunk), chunk=chunk)
     it = iter(feeder)
     return (lambda: next(it)), feeder.stop
 
@@ -559,14 +582,16 @@ class BenchmarkCNN:
 
   def _benchmark_train(self) -> Dict[str, Any]:
     p = self.params
-    init_state, train_step, eval_step, broadcast_init = self._build()
+    init_state, train_step, eval_step, broadcast_init, train_chunk = \
+        self._build()
     rng = jax.random.PRNGKey(p.tf_random_seed or 0)
     data_rng, init_rng = jax.random.split(rng)
     self._data_rng = data_rng
     next_batch = self._open_input(data_rng, "train")
     try:
       return self._train_loop(init_state, train_step, eval_step,
-                              broadcast_init, init_rng, next_batch)
+                              broadcast_init, init_rng, next_batch,
+                              train_chunk)
     finally:
       self._input_stop()
 
@@ -578,7 +603,10 @@ class BenchmarkCNN:
       stop_prev()
       self._input_incarnation = getattr(self, "_input_incarnation", 0) + 1
       rng = jax.random.fold_in(rng, self._input_incarnation)
-    next_batch, stop = self._input_iterator(rng, subset)
+    # Training streams stage --steps_per_dispatch batches per fetch
+    # (already 1 in eval/forward-only modes, validation.py).
+    chunk = self.steps_per_dispatch if subset == "train" else 1
+    next_batch, stop = self._input_iterator(rng, subset, chunk=chunk)
     self._input_stop = stop
     return next_batch
 
@@ -610,23 +638,36 @@ class BenchmarkCNN:
         self.params, self.batch_size * max(self.num_workers, 1),
         self.dataset.num_examples_per_epoch("train"), self.num_batches,
         start_step=steps_done, start_examples=examples_done)
-    init_state, train_step, eval_step, broadcast_init = self._build()
+    init_state, train_step, eval_step, broadcast_init, train_chunk = \
+        self._build()
     next_batch = self._open_input(self._data_rng, "train")
     shape = (batch_per_device,) + self._model_image_shape()
     new_state = init_state(init_rng, jnp.zeros(shape, jnp.float32))
     new_state = checkpoint.restore_state(new_state, snapshot)
     new_state = new_state.replace(
         params=broadcast_init(new_state.params))
-    return new_state, train_step, eval_step, next_batch
+    return new_state, train_step, eval_step, next_batch, train_chunk
 
   def _train_loop(self, init_state, train_step, eval_step, broadcast_init,
-                  init_rng, next_batch) -> Dict[str, Any]:
+                  init_rng, next_batch, train_chunk=None) -> Dict[str, Any]:
     p = self.params
+    K = self.steps_per_dispatch
+    chunked = K > 1 and train_chunk is not None
+    synthetic = self.dataset.use_synthetic_gpu_inputs()
     images, labels = next_batch()
 
+    def _step_slice(ims, lbs, j: int = 0):
+      """One per-step batch out of a staged chunk (identity when
+      unchunked). The synthetic resident chunk has a single slot."""
+      if not chunked:
+        return ims, lbs
+      jj = 0 if synthetic else min(j, ims.shape[0] - 1)
+      return ims[jj], jax.tree.map(lambda x: x[jj], lbs)
+
+    single_images, _ = _step_slice(images, labels)
     sample = jax.ShapeDtypeStruct(
-        (self.batch_size_per_device,) + tuple(images.shape[1:]),
-        images.dtype)
+        (self.batch_size_per_device,) + tuple(single_images.shape[1:]),
+        single_images.dtype)
     replicated = mesh_lib.replicated_sharding(self.mesh)
     log_fn("Generating training model")
     t0 = time.time()
@@ -712,8 +753,10 @@ class BenchmarkCNN:
     if p.graph_file or p.tfprof_file or p.partitioned_graph_file_prefix:
       # One lowering feeds all dumps (tracing a big model twice is
       # minutes of redundant startup work). Forward-only dumps the eval
-      # program it actually runs.
-      dump_fn = eval_step if p.forward_only else train_step
+      # program it actually runs; chunked runs dump the K-step scanned
+      # program (the unit of dispatch the timed loop executes).
+      dump_fn = eval_step if p.forward_only else (
+          train_chunk if chunked else train_step)
       lowered = dump_fn.lower(state, images, labels)
       if p.graph_file:
         observability.dump_program_text(lowered, p.graph_file)
@@ -731,7 +774,8 @@ class BenchmarkCNN:
         # The operator-facing top-op ranking the reference printed from
         # tfprof (ref: benchmark_cnn.py:1208-1228).
         table = observability.dump_per_op_profile(
-            compiled, p.tfprof_file + ".ops.txt")
+            compiled, p.tfprof_file + ".ops.txt",
+            steps_per_dispatch=self.steps_per_dispatch)
         for line in table.splitlines():
           log_fn(line)
       if p.partitioned_graph_file_prefix:
@@ -767,28 +811,69 @@ class BenchmarkCNN:
     pre_trace_runs = (observability.list_profile_runs(trace_dir)
                       if p.trace_file and p.tfprof_file else [])
 
+    def _traced(trace_file, idx, trace_at, fn, *args):
+      """One dispatch under the single-dispatch trace policy: trace it
+      when ``idx == trace_at`` (warmup traces its LAST dispatch, ref
+      :806-817 traces step -2 for the same reason; with zero warmup the
+      timed loop traces its first) and -- dispatch being async -- drain
+      inside the profiler context so the trace spans the device
+      execution (utils/sync.py on why block_until_ready is not enough).
+      The ONE place this invariant lives; every dispatch site routes
+      through it."""
+      with observability.maybe_trace_step(trace_file, idx, trace_at):
+        new_state, out_metrics = fn(*args)
+        if trace_file and idx == trace_at:
+          sync.drain(out_metrics)
+      return new_state, out_metrics
+
     log_fn("Running warm up")
     t0 = time.time()
-    for w in range(self.num_warmup_batches):
-      # Trace a WARMUP step (the last one) so profiler start/stop and
-      # trace serialization never pollute the timed region -- the
-      # reference traces step -2 for the same reason (ref :806-817).
-      with observability.maybe_trace_step(
-          p.trace_file, w, self.num_warmup_batches - 1):
-        state, metrics = run_step(state, images, labels)
-        if p.trace_file and w == self.num_warmup_batches - 1:
-          # The trace must span the device execution, so the traced
-          # step resolves inside the profiler context (utils/sync.py on
-          # why block_until_ready is not enough).
-          sync.drain(metrics)
-      images, labels = next_batch()
-    if self.num_warmup_batches and not p.trace_file:
-      # Empty the device queue before the clock starts: timing must not
-      # begin with warmup steps still executing (utils/sync.py). With
-      # --trace_file the traced last step already drained in-context.
-      sync.drain(metrics)
+    cursor = 0  # consumed slices of the current staged real-data chunk
+    if chunked:
+      # Exactly num_warmup_batches warmup steps, like K=1: q whole
+      # chunks first, then r = W mod K single steps consuming slices of
+      # the next staged chunk. The warmed-up STATE and (real data) the
+      # stream position are therefore identical to the K=1 loop's,
+      # which is what keeps the timed per-step losses bit-identical
+      # across K. The chunk program compiles here when q >= 1 and the
+      # single-step program when r >= 1; a program not exercised by
+      # this split compiles at its first use instead (a tail/event
+      # dispatch, or -- when W < K -- the first timed chunk).
+      q, r = divmod(self.num_warmup_batches, K)
+      n_dispatches = q + r
+      w = 0
+      for _ in range(q):
+        state, metrics = _traced(p.trace_file, w, n_dispatches - 1,
+                                 train_chunk, state, images, labels)
+        images, labels = next_batch()
+        w += 1
+      for _ in range(r):
+        state, metrics = _traced(p.trace_file, w, n_dispatches - 1,
+                                 run_step, state,
+                                 *_step_slice(images, labels, cursor))
+        if not synthetic:
+          cursor += 1
+          if cursor >= images.shape[0]:
+            images, labels = next_batch()
+            cursor = 0
+        w += 1
+      warm_steps = self.num_warmup_batches
+      if n_dispatches and not p.trace_file:
+        sync.drain(metrics)
+    else:
+      for w in range(self.num_warmup_batches):
+        state, metrics = _traced(p.trace_file, w,
+                                 self.num_warmup_batches - 1,
+                                 run_step, state, images, labels)
+        images, labels = next_batch()
+      warm_steps = self.num_warmup_batches
+      if self.num_warmup_batches and not p.trace_file:
+        # Empty the device queue before the clock starts: timing must not
+        # begin with warmup steps still executing (utils/sync.py). With
+        # --trace_file the traced last step already drained in-context.
+        sync.drain(metrics)
     log_fn("Warmup (compile + %d steps): %.1f s" %
-           (self.num_warmup_batches, time.time() - t0))
+           (warm_steps, time.time() - t0))
     # Base for globally-meaningful step numbers in metric/summary streams
     # (resumed runs must not restart their step axis at 1).
     start_step = int(state.step)
@@ -799,6 +884,7 @@ class BenchmarkCNN:
     log_fn(header)
 
     step_train_times = []
+    chunk_times = []  # wall interval per K-step dispatch (chunked mode)
     loss = float("nan")
     stopped_early = False
     restart_requested = None
@@ -817,6 +903,8 @@ class BenchmarkCNN:
     def _handle(done: "pipeline_lib.CompletedStep"):
       nonlocal loss, last_display_len
       step_train_times.append(done.interval)
+      if done.chunk_len > 1 and done.chunk_end:
+        chunk_times.append(done.chunk_interval)
       m = done.metrics
       loss = float(m[p.loss_type_to_report])
       if noise_ema is not None and "noise_scale_g2" in m:
@@ -851,33 +939,92 @@ class BenchmarkCNN:
               start_step + i1,
               jax.tree.map(lambda x: x[0], state.params), "params")
 
+    # Step-keyed schedule predicates. The SAME functions feed both the
+    # dispatch-length planner (_event_due) and the post-dispatch due
+    # flags below, so the chunk-shortening contract ("a chunk never
+    # crosses a scheduled step") cannot drift from the schedule that
+    # actually fires. The seconds-based checkpoint cadence is not
+    # step-keyed: it is checked at dispatch boundaries only, so under
+    # chunking it can land up to K-1 steps late -- it is a wall-clock
+    # schedule already.
+    def _save_steps_due(s: int) -> bool:
+      return bool(p.train_dir and p.save_model_steps and
+                  s % p.save_model_steps == 0)
+
+    def _eval_sched_due(s: int) -> bool:
+      return bool((p.eval_during_training_every_n_steps and
+                   s % p.eval_during_training_every_n_steps == 0) or
+                  s in self.eval_step_set)
+
+    def _elastic_sched_due(s: int) -> bool:
+      return bool((controller is not None or batch_policy is not None) and
+                  s % p.elastic_check_every_n_steps == 0)
+
+    def _event_due(s: int) -> bool:
+      """A host intervention is scheduled immediately after step ``s``."""
+      return (_save_steps_due(s) or _eval_sched_due(s) or
+              _elastic_sched_due(s))
+
+    def _dispatch_len(done_steps: int) -> int:
+      """Length of the next dispatch: up to K steps, stopping at the run
+      end and BEFORE any step-keyed event strictly inside the window, so
+      checkpoints/eval/elastic keep exact K=1 step semantics (the chunk
+      shortens; the short remainder runs as single steps)."""
+      n = min(K, self.num_batches - done_steps)
+      for d in range(1, n):
+        if _event_due(done_steps + d):
+          return d
+      return n
+
     loop_start = time.time()
     pipe.reset_clock()
-    for i in range(self.num_batches):
-      save_due = p.train_dir and (
-          (p.save_model_steps and (i + 1) % p.save_model_steps == 0) or
-          (p.save_model_secs and
-           time.time() - last_save_time >= p.save_model_secs))
-      eval_due = bool(
-          (p.eval_during_training_every_n_steps and
-           (i + 1) % p.eval_during_training_every_n_steps == 0) or
-          (i + 1) in self.eval_step_set)
-      elastic_due = (
-          (controller is not None or batch_policy is not None) and
-          (i + 1) % p.elastic_check_every_n_steps == 0)
-      # (trace fallback: with zero warmup steps the trace runs here)
-      trace_this_step = p.trace_file and self.num_warmup_batches == 0 and \
-          i == 0
-      with observability.maybe_trace_step(
-          p.trace_file if self.num_warmup_batches == 0 else None, i):
-        state, metrics = run_step(state, images, labels)
-        if trace_this_step:
-          # Dispatch is async; the trace must span the device execution.
-          sync.drain(metrics)
-      images, labels = next_batch()
-      images_processed += self.batch_size * max(self.num_workers, 1)
-      for done in pipe.push(i + 1, metrics):
-        _handle(done)
+    i = 0  # steps completed (cursor carries over from warmup)
+    while i < self.num_batches:
+      n_dispatch = _dispatch_len(i) if chunked else 1
+      if chunked and not synthetic and cursor:
+        # Mid-chunk (warmup remainder or an event-shortened dispatch
+        # consumed part of the staged chunk): run single steps only up
+        # to the chunk boundary, so the NEXT dispatch meets a fully
+        # unconsumed chunk. Without this cap an event-free run would
+        # execute K singles per iteration, land on the same cursor
+        # residue forever, and never dispatch a chunk at all.
+        n_dispatch = min(n_dispatch, images.shape[0] - cursor)
+      # A full-K dispatch needs a chunk-aligned input: the synthetic
+      # resident batch always is; a staged real-data chunk only when
+      # fully unconsumed.
+      use_chunk = (chunked and n_dispatch == K and
+                   (synthetic or (cursor == 0 and images.shape[0] == K)))
+      # (trace fallback: with zero warmup dispatches the trace runs on
+      # the FIRST timed dispatch, via _traced's trace_at == i == 0)
+      timed_trace = p.trace_file if self.num_warmup_batches == 0 else None
+      if use_chunk:
+        state, metrics = _traced(timed_trace, i, 0,
+                                 train_chunk, state, images, labels)
+        images, labels = next_batch()
+        i += K
+        images_processed += K * self.batch_size * max(self.num_workers, 1)
+        for done in pipe.push(i, metrics, count=K):
+          _handle(done)
+      else:
+        for _ in range(n_dispatch):
+          state, metrics = _traced(timed_trace, i, 0, run_step, state,
+                                   *_step_slice(images, labels, cursor))
+          if not chunked:
+            images, labels = next_batch()
+          elif not synthetic:
+            cursor += 1
+            if cursor >= images.shape[0]:
+              images, labels = next_batch()
+              cursor = 0
+          i += 1
+          images_processed += self.batch_size * max(self.num_workers, 1)
+          for done in pipe.push(i, metrics):
+            _handle(done)
+      save_due = _save_steps_due(i) or bool(
+          p.train_dir and p.save_model_secs and
+          time.time() - last_save_time >= p.save_model_secs)
+      eval_due = _eval_sched_due(i)
+      elastic_due = _elastic_sched_due(i)
       if save_due or eval_due or elastic_due:
         # Sync point: resolve everything in flight so checkpoint/eval/
         # resize wall time stays out of the per-step timing, then exclude
@@ -893,7 +1040,8 @@ class BenchmarkCNN:
           last_save_time = time.time()
         if eval_due:
           # Mid-training eval + early stop (ref: benchmark_cnn.py:2310-2324).
-          acc = jax.device_get(eval_step(state, images, labels))
+          acc = jax.device_get(
+              eval_step(state, *_step_slice(images, labels, cursor)))
           top1 = float(acc["top_1_accuracy"])
           log_fn("Accuracy @ 1 = %.4f Accuracy @ 5 = %.4f [%d examples]" %
                  (top1, float(acc["top_5_accuracy"]), self.batch_size))
@@ -905,13 +1053,13 @@ class BenchmarkCNN:
         # Elastic resize / adaptive batch (north-star KungFu capabilities;
         # SURVEY 2.9, 5.3). Polled at a fixed cadence to keep the hot loop
         # collective-free.
-        if elastic_due and (i + 1) < self.num_batches:
+        if elastic_due and i < self.num_batches:
           new_n = None
           restart_np = None
           under_kfrun = "KFCOORD_WORLD" in os.environ
           if controller is not None:
             poll_at = getattr(controller, "poll_at", None)
-            new_n = poll_at(i + 1) if poll_at else controller.poll()
+            new_n = poll_at(i) if poll_at else controller.poll()
             raw = getattr(controller, "last_raw_target", None)
             if new_n is not None and raw and under_kfrun:
               # Under the kfrun launcher the RESIZE target is a GLOBAL
@@ -937,7 +1085,7 @@ class BenchmarkCNN:
                 if (hasattr(controller, "scheduled_restart") and
                     controller.scheduled_restart() is None):
                   k = max(1, p.elastic_check_every_n_steps)
-                  controller.schedule_restart((i + 1) + 2 * k, value)
+                  controller.schedule_restart(i + 2 * k, value)
                 # The restart owns this resize: the clamped global poll
                 # value must not fall through to the per-process
                 # in-mesh reshape below.
@@ -952,7 +1100,7 @@ class BenchmarkCNN:
               if sched is not None:
                 sched_step, sched_np = sched
                 if (sched_np != max(self.num_workers, 1) and
-                    (i + 1) >= sched_step):
+                    i >= sched_step):
                   restart_np = sched_np
             if restart_np is None and new_n == self.num_devices:
               new_n = None
@@ -968,7 +1116,7 @@ class BenchmarkCNN:
                                          p.max_ckpts_to_keep)
               log_fn("Elastic restart at step %d: workers %d -> %d "
                      "(checkpoint + re-exec under the launcher)" % (
-                         i + 1, max(self.num_workers, 1), restart_np))
+                         i, max(self.num_workers, 1), restart_np))
               # SPMD lockstep: every worker reaches this at the same
               # step; the barrier holds exits until the chief's
               # checkpoint write completed (the chief enters after
@@ -1003,23 +1151,24 @@ class BenchmarkCNN:
                      f"flag validation ({e}); keeping current topology")
               new_n = None
           if new_n or new_bs:
-            event = {"step": i + 1,
+            event = {"step": i,
                      "num_devices": new_n or self.num_devices,
                      "batch_size_per_device":
                          new_bs or self.batch_size_per_device,
                      "b_simple": noise_ema.b_simple if noise_ema else None}
             log_fn("Elastic reshape at step %d: devices %d -> %d, "
                    "per-device batch %d -> %d" % (
-                       i + 1, self.num_devices, event["num_devices"],
+                       i, self.num_devices, event["num_devices"],
                        self.batch_size_per_device,
                        event["batch_size_per_device"]))
-            state, train_step, eval_step, next_batch = \
+            state, train_step, eval_step, next_batch, train_chunk = \
                 self._reshape_topology(state, event["num_devices"],
                                        event["batch_size_per_device"],
-                                       init_rng, steps_done=i + 1,
+                                       init_rng, steps_done=i,
                                        examples_done=images_processed)
             run_step = make_run_step(train_step, eval_step)
             images, labels = next_batch()
+            cursor = 0
             reshape_events.append(event)
         pipe.note_aux_time(time.time() - aux_start)
     for done in pipe.flush():
@@ -1034,12 +1183,26 @@ class BenchmarkCNN:
     log_fn("-" * 64)
     log_fn("total images/sec: %.2f" % images_per_sec)
     log_fn("-" * 64)
+    if chunked and chunk_times:
+      # Per-chunk timing rows: the dispatch-granularity wall clock the
+      # amortized per-step numbers above are derived from (honest-timing
+      # note in utils/pipeline.py).
+      for line in observability.chunk_timing_rows(
+          K, chunk_times, self.batch_size * max(self.num_workers, 1)):
+        log_fn(line)
     if bench_logger is not None:
       # Final throughput metrics (ref: _log_benchmark_run
       # average_examples_per_sec emission).
       bench_logger.log_metric("average_examples_per_sec", images_per_sec,
                               unit="examples/sec",
                               global_step=start_step + num_steps)
+      if chunked and chunk_times:
+        bench_logger.log_metric(
+            "chunk_wall_time_mean",
+            sum(chunk_times) / len(chunk_times), unit="seconds",
+            global_step=start_step + num_steps,
+            extras={"steps_per_dispatch": K,
+                    "num_chunks": len(chunk_times)})
     if p.tfprof_file:
       # The measured half of the tfprof analog (ref: benchmark_cnn.py:
       # 1208-1228 ranks ops by MEASURED accelerator time from RunMetadata):
@@ -1075,6 +1238,8 @@ class BenchmarkCNN:
         "images_per_sec": images_per_sec,
         "last_average_loss": loss,
         "stopped_early": stopped_early,
+        "steps_per_dispatch": K,
+        "num_chunks": len(chunk_times),
         # Set when a cross-process resize needs the launcher to re-exec
         # this worker set at a new world size (kfrun restart leg).
         "restart_for_resize": restart_requested,
@@ -1148,7 +1313,7 @@ class BenchmarkCNN:
     fresh-init model on synthetic data.
     """
     p = self.params
-    init_state, train_step, eval_step, broadcast_init = self._build()
+    init_state, train_step, eval_step, broadcast_init, _ = self._build()
     rng = jax.random.PRNGKey(p.tf_random_seed or 0)
     data_rng, init_rng = jax.random.split(rng)
     shape = self._model_image_shape()

@@ -1,0 +1,56 @@
+"""JAX API compatibility for the versions this tree meets in the wild.
+
+The codebase targets the current ``jax.shard_map(f, mesh=..., in_specs=...,
+out_specs=..., check_vma=...)`` API. Some execution environments (this
+container ships jax 0.4.37) predate the top-level export: there the entry
+point is ``jax.experimental.shard_map.shard_map`` and the per-output
+replication checker is spelled ``check_rep`` rather than ``check_vma``.
+
+Importing :mod:`kf_benchmarks_tpu` installs a thin forwarding wrapper at
+``jax.shard_map`` when (and only when) the top-level API is absent, so
+every call site -- library and tests -- runs unmodified on both API
+generations. On current jax this module is a no-op: nothing is patched
+and the native implementation is used directly.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _install_shard_map_shim() -> None:
+  if hasattr(jax, "shard_map"):
+    return
+  from jax.experimental import shard_map as _experimental_shard_map
+
+  def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                check_vma=True, **kwargs):
+    # check_vma maps to 0.4.x's check_rep, but pre-vma check_rep is
+    # force-disabled: without lax.pcast there is no way to align the
+    # replication types it infers for cond branches / scan carries
+    # (sequence.py vary_like), so it rejects valid programs with
+    # "branches of cond produced mismatched replication types". The
+    # checker still runs wherever the real jax.shard_map exists.
+    del check_vma
+    return _experimental_shard_map.shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False, **kwargs)
+
+  jax.shard_map = shard_map
+
+
+def _install_axis_size_shim() -> None:
+  from jax import lax
+  if hasattr(lax, "axis_size"):
+    return
+
+  def axis_size(axis_name):
+    # The pre-export idiom: psum of a literal constant folds to the
+    # STATIC axis size (a Python int) inside collective contexts.
+    return lax.psum(1, axis_name)
+
+  lax.axis_size = axis_size
+
+
+_install_shard_map_shim()
+_install_axis_size_shim()

@@ -7,6 +7,16 @@ preprocessing.py:368-399 MultiDeviceIterator): a background thread pulls
 host batches from the preprocessor iterator and ``jax.device_put``s them
 onto the global batch sharding ahead of the step loop, so the H2D copy
 overlaps the previous step's compute.
+
+Chunk mode (--steps_per_dispatch=K): ``chunk=K`` makes the worker stage K
+host batches at a time -- stacked on a new leading axis host-side and
+transferred as ONE (K, batch, ...) array onto the chunk sharding -- so a
+K-step scanned dispatch finds its whole input staged and never waits on
+H2D mid-scan. The queue then counts chunks (``prefetch`` stays in
+batches and is rounded up to whole chunks), keeping roughly the same
+number of batches in flight as the unchunked feed. A stream that ends
+mid-chunk yields a final partial stack (leading axis < K); the consumer
+runs those through the single-step program.
 """
 
 from __future__ import annotations
@@ -16,6 +26,7 @@ import threading
 from typing import Iterator, Optional, Tuple
 
 import jax
+import numpy as np
 
 from kf_benchmarks_tpu.parallel import mesh as mesh_lib
 
@@ -24,15 +35,35 @@ class DeviceFeeder:
   """Prefetching device-transfer iterator (depth-``prefetch`` pipeline)."""
 
   def __init__(self, host_iterator: Iterator, sharding,
-               prefetch: int = 2):
+               prefetch: int = 2, chunk: int = 1):
     self._host_iterator = host_iterator
     self._sharding = sharding
-    self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, prefetch))
+    self._chunk = max(1, chunk)
+    depth = -(-max(1, prefetch) // self._chunk)  # batches -> whole chunks
+    self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
     self._stop = threading.Event()
     self._error: Optional[BaseException] = None
     self._thread = threading.Thread(target=self._worker, daemon=True,
                                     name="device-feeder")
     self._thread.start()
+
+  def _pull(self, it):
+    """Next host item: one batch, or a chunk of up to ``chunk`` batches
+    stacked on a new leading axis. None at stream end."""
+    if self._chunk == 1:
+      try:
+        return next(it)
+      except StopIteration:
+        return None
+    batches = []
+    while len(batches) < self._chunk and not self._stop.is_set():
+      try:
+        batches.append(next(it))
+      except StopIteration:
+        break
+    if not batches:
+      return None
+    return jax.tree.map(lambda *xs: np.stack(xs), *batches)
 
   def _worker(self) -> None:
     try:
@@ -41,9 +72,8 @@ class DeviceFeeder:
       # preprocessing work happens, so a stopped feeder must not decode
       # another full global batch just to discard it.
       while not self._stop.is_set():
-        try:
-          batch = next(it)
-        except StopIteration:
+        batch = self._pull(it)
+        if batch is None:
           break
         device_batch = mesh_lib.put_batch(batch, self._sharding)
         while not self._stop.is_set():

@@ -284,11 +284,36 @@ def per_op_costs(hlo_text: str):
 PER_OP_TABLE_HEADER = ("rank  est_time_us  %total        flops"
                        "        bytes  op")
 
+# Measured axon-tunnel host<->device round trip (PERF.md): the per-
+# dispatch cost the per-op device rows cannot see. Local PCIe dispatch
+# is far cheaper; the table prints the tunnel figure because that is
+# this deployment's wall-clock reality.
+DISPATCH_RTT_S = 0.070
 
-def per_op_table(hlo_text: str, top_n: int = 20) -> str:
+
+def dispatch_overhead_line(est_step_s: float, steps_per_dispatch: int = 1,
+                           rtt_s: float = DISPATCH_RTT_S) -> str:
+  """One roofline-table line for the HOST axis: every dispatch pays
+  ~``rtt_s`` of tunnel round trip regardless of how much device work it
+  carries, so K scanned steps per dispatch (--steps_per_dispatch)
+  amortize it K-fold. ``est_step_s`` is the static per-step estimate
+  (the scanned while body is counted once in the static table, so one
+  step's estimate times K approximates the chunk)."""
+  k = max(1, int(steps_per_dispatch))
+  per_dispatch_s = est_step_s * k
+  frac = rtt_s / max(per_dispatch_s + rtt_s, 1e-12)
+  return (f"dispatch overhead: ~{rtt_s * 1e3:.0f} ms RTT/dispatch over "
+          f"{k} step(s)/dispatch "
+          f"({per_dispatch_s * 1e6:.1f} us est device work/dispatch) "
+          f"-> {100.0 * frac:.1f}% of dispatch wall at the roofline")
+
+
+def per_op_table(hlo_text: str, top_n: int = 20,
+                 steps_per_dispatch: int = 1) -> str:
   """The tfprof top-op table analog (ref: benchmark_cnn.py:1208-1228
   prints the top-20 ops by accelerator time): top-``top_n`` HLO
-  instructions by roofline-estimated device time."""
+  instructions by roofline-estimated device time, closed by the
+  dispatch-overhead line (the host cost no per-op row carries)."""
   rows = per_op_costs(hlo_text)
   rows.sort(key=lambda r: r["est_time_s"], reverse=True)
   total = sum(r["est_time_s"] for r in rows) or 1.0
@@ -300,16 +325,49 @@ def per_op_table(hlo_text: str, top_n: int = 20) -> str:
         f"{rank:4d}  {r['est_time_s'] * 1e6:11.1f}  "
         f"{100.0 * r['est_time_s'] / total:5.1f}%  {r['flops']:11.3e}  "
         f"{r['bytes']:11.3e}  {r['name']} {r['opcode']}")
+  lines.append(dispatch_overhead_line(total, steps_per_dispatch))
   return "\n".join(lines)
 
 
-def dump_per_op_profile(compiled, path: str, top_n: int = 20) -> str:
+def dump_per_op_profile(compiled, path: str, top_n: int = 20,
+                        steps_per_dispatch: int = 1) -> str:
   """Write the per-op table next to the tfprof cost JSON and return it."""
-  table = per_op_table(compiled.as_text(), top_n=top_n)
+  table = per_op_table(compiled.as_text(), top_n=top_n,
+                       steps_per_dispatch=steps_per_dispatch)
   os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
   with open(path, "w") as f:
     f.write(table + "\n")
   return table
+
+
+def chunk_timing_rows(steps_per_dispatch: int, chunk_intervals,
+                      global_batch: int, max_rows: int = 8):
+  """Per-chunk timing rows for the chunked dispatch mode
+  (--steps_per_dispatch): the dispatch-granularity wall intervals the
+  amortized per-step stats derive from, printed so an operator can see
+  chunk-to-chunk variation directly. Shows the last ``max_rows`` chunks
+  plus a summary line over all of them."""
+  k = max(1, int(steps_per_dispatch))
+  times = list(chunk_intervals)
+  if not times:
+    return []
+  mean = sum(times) / len(times)
+  lines = [
+      "dispatch chunks (K=%d): %d dispatches, mean %.1f ms/chunk "
+      "(%.2f ms/step, %.1f img/s), min %.1f ms, max %.1f ms" % (
+          k, len(times), mean * 1e3, mean / k * 1e3,
+          k * global_batch / max(mean, 1e-9),
+          min(times) * 1e3, max(times) * 1e3),
+      "chunk  wall_ms  img/s",
+  ]
+  first = max(0, len(times) - max_rows)
+  if first:
+    lines.append(f"  ... ({first} earlier chunks elided)")
+  for idx in range(first, len(times)):
+    t = times[idx]
+    lines.append("%5d  %7.1f  %.1f" % (
+        idx + 1, t * 1e3, k * global_batch / max(t, 1e-9)))
+  return lines
 
 
 # -- MEASURED per-op profile from the captured trace ------------------------

@@ -124,10 +124,14 @@ def gossip_shift(step, axis_size: int):
 
   * n <= GOSSIP_SWITCH_MAX_N: the offset rotates through 1..n-1, so
     every replica pairs with every other within n-1 steps.
-  * n > GOSSIP_SWITCH_MAX_N: HYPERCUBE offsets -- 2^(step mod
-    ceil(log2 n)) mod n. Every offset is a single cyclic permutation
-    (one ppermute, ONE tree-sized send), and the binary expansion
-    connects all n replicas within ceil(log2 n) steps -- faster mixing
+  * n > GOSSIP_SWITCH_MAX_N: HYPERCUBE offsets -- the schedule cycles
+    through the ceil(log2 n) == (n-1).bit_length() power-of-two shifts
+    2^0..2^(ceil(log2 n)-1) (each < n, so valid at ANY axis size, not
+    just powers of two). Every offset is a single cyclic permutation
+    (one ppermute, ONE tree-sized send), and because every residue
+    0..n-1 is a subset-sum of those powers mod n, all n replicas mix
+    within ceil(log2 n) steps -- at non-power-of-two n included
+    (pinned by test_strategies.py's n=6 submesh case) -- faster mixing
     than the 1..n-1 rotation needs n-1 steps for, at 1/log2(n) of the
     wire cost the round-2 gated-hop lowering paid (which sent the tree
     on every of its log2 n hops and gated the result; measured 2.1x

@@ -76,3 +76,11 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
   return NamedSharding(mesh, P(REPLICA_AXIS))
+
+
+def chunk_batch_sharding(mesh: Mesh) -> NamedSharding:
+  """Sharding for a staged multi-step chunk (--steps_per_dispatch):
+  leading axis = staged steps (replicated), second axis = the global
+  batch sharded over replicas -- the per-step batch_sharding behind a
+  chunk dimension."""
+  return NamedSharding(mesh, P(None, REPLICA_AXIS))

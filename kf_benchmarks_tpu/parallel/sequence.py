@@ -83,6 +83,11 @@ def vary_like(ref, arrays, default_axes=(), extra_axes=()):
   the axes each array is MISSING are pcast -- pcast rejects
   already-varying axes.
   """
+  if not hasattr(lax, "pcast"):
+    # Pre-vma jax (e.g. 0.4.x): avals carry no varying-manual-axes type
+    # information and shard_map's check_rep accepts untyped carries, so
+    # there is nothing to cast.
+    return arrays
   want = (set(getattr(ref.aval, "vma", ()) or default_axes)
           | set(extra_axes))
   if not want:
@@ -547,7 +552,12 @@ def pallas_flash_attention(q, k, v, causal: bool = False,
   if block is not None:
     if block_sizes is not None:
       raise ValueError("pass block OR block_sizes, not both")
-    block_sizes = uniform_flash_block_sizes(min(block, q.shape[1]))
+    # Clamp to BOTH sequence lengths: the uniform BlockSizes tile the
+    # K/V axis too, so a short-KV (cross-attention-shaped) input with
+    # kv_len < block would otherwise mis-tile the k-major grid
+    # (advisor round-5).
+    block_sizes = uniform_flash_block_sizes(
+        min(block, q.shape[1], k.shape[1]))
   d = q.shape[-1]
   scale = (1.0 / math.sqrt(d)) if scale is None else scale
   qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))

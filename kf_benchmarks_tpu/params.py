@@ -28,6 +28,17 @@ flags.DEFINE_integer("batch_group_size", 1,
                      "Number of batches the input feeder keeps in flight "
                      "ahead of the step loop (ref :134-136; wired to the "
                      "DeviceFeeder prefetch depth).", lower_bound=1)
+flags.DEFINE_integer("steps_per_dispatch", 1,
+                     "Device-resident multi-step training: compile K "
+                     "train steps into one lax.scan program so host "
+                     "dispatch, tunnel RTT, and metric fetches are paid "
+                     "once per K steps (the TPU-native analog of the "
+                     "reference's in-graph loops / amortized sess.run "
+                     "fetches, ref: benchmark_cnn.py:786-884 step "
+                     "semantics). 1 = one dispatch per step. Per-step "
+                     "losses are unchanged; wall-clock timing is honest "
+                     "at chunk granularity (utils/pipeline.py).",
+                     lower_bound=1)
 flags.DEFINE_integer("num_batches", None,
                      "Number of timed batches to run (ref :137-139).")
 flags.DEFINE_float("num_epochs", None,

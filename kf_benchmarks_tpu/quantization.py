@@ -42,14 +42,42 @@ def _is_qleaf(x) -> bool:
   return isinstance(x, dict) and _QKEY in x and _SKEY in x
 
 
+# A 4-D kernel whose trailing axis is at most this wide (and narrower
+# than its in-features axis) is treated as a TF-layout depthwise kernel
+# (h, w, in, multiplier): real depthwise multipliers are tiny (1-8),
+# while genuine output-feature axes are channel-scale wide.
+DEPTHWISE_MULTIPLIER_MAX = 8
+
+
+def _scale_axes(w) -> tuple:
+  """Axes the per-channel absmax reduces over: everything except the
+  output channels.
+
+  Standard kernels put output features LAST -- dense (in, out), conv
+  (h, w, in, out), and the flax depthwise layout (h, w, 1, in*mult) --
+  so the reduction covers all leading axes. A TF-layout depthwise
+  kernel (h, w, in, multiplier) spreads its output channels over the
+  last TWO axes: reducing over (h, w, in) there would collapse every
+  input channel into one multiplier-wide scale (multiplier=1: a single
+  scale for the whole kernel), losing the per-channel dynamic range the
+  scheme exists for. Those reduce over the spatial axes only, giving
+  one scale per (in, multiplier) output channel.
+  """
+  if (w.ndim == 4 and w.shape[3] <= DEPTHWISE_MULTIPLIER_MAX
+      and w.shape[3] < w.shape[2]):
+    return (0, 1)
+  return tuple(range(w.ndim - 1))
+
+
 def quantize_variables(variables, min_elems: int = MIN_QUANT_ELEMS):
   """Float kernels -> {int8 q, f32 per-out-channel scale} leaves.
 
-  Symmetric per-output-channel quantization over the LAST axis (the
-  output-features axis of both dense (in, out) and conv (h, w, in, out)
-  kernels): scale[c] = max|w[..., c]| / 127. Leaves that are not float,
-  have fewer than 2 axes, or fewer than ``min_elems`` elements pass
-  through unchanged.
+  Symmetric per-output-channel quantization: scale = max|w| / 127 over
+  each output channel, with the channel axes resolved per layout
+  (``_scale_axes``; the depthwise (h, w, in, multiplier) layout keeps
+  per-(in, multiplier) scales). Leaves that are not float, have fewer
+  than 2 axes, or fewer than ``min_elems`` elements pass through
+  unchanged.
   """
 
   def quant(w):
@@ -58,8 +86,7 @@ def quantize_variables(variables, min_elems: int = MIN_QUANT_ELEMS):
     if (w.ndim < 2 or w.size < min_elems
         or not jnp.issubdtype(w.dtype, jnp.floating)):
       return w
-    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)),
-                     axis=tuple(range(w.ndim - 1)))
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=_scale_axes(w))
     scale = jnp.maximum(absmax, 1e-12) / 127.0
     q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
     return {_QKEY: q.astype(jnp.int8), _SKEY: scale}

@@ -44,6 +44,16 @@ def validate_cross_flags(params) -> None:
                      "set (ref :1300-1303)")
   if p.num_batches is not None and p.num_batches <= 0:
     raise ParamError("--num_batches must be positive")
+  if (getattr(p, "steps_per_dispatch", 1) or 1) > 1:
+    # Chunked dispatch wraps the TRAIN step in a device-resident scan
+    # (train_step.py); eval/forward-only loops dispatch a stateless
+    # forward per step and are not chunked (yet).
+    if p.eval:
+      raise ParamError("--steps_per_dispatch > 1 applies to training "
+                       "only; it cannot be combined with --eval")
+    if p.forward_only:
+      raise ParamError("--steps_per_dispatch > 1 applies to training "
+                       "only; it cannot be combined with --forward_only")
   if p.num_epochs is not None and p.num_epochs <= 0:
     raise ParamError("--num_epochs must be positive")
   if p.num_eval_batches is not None and p.num_eval_epochs is not None:

@@ -50,6 +50,7 @@ def main(argv=None):
     parser.error("--run_distributed_tests selects ONLY the "
                  "process-spawning suites; run the two invocations "
                  "separately (the reference gates them the same way)")
+  marker = []
   if args.run_distributed_tests:
     targets = DISTRIBUTED_TESTS
   else:
@@ -60,7 +61,14 @@ def main(argv=None):
             os.path.join(REPO, "tests"))
         if name.startswith("test_") and name.endswith(".py")
         and os.path.join("tests", name) not in skip)
-  cmd = [sys.executable, "-m", "pytest", "-q"] + targets + pytest_args
+    if not args.full_tests:
+      # The fast tier gates by BOTH mechanisms: the file list above and
+      # the @pytest.mark.slow markers carried by individual heavy tests
+      # inside otherwise-fast files (e.g. the 2x48-step dispatch
+      # benchmark); --full_tests runs everything either way.
+      marker = ["-m", "not slow"]
+  cmd = [sys.executable, "-m", "pytest", "-q"] + marker + targets \
+      + pytest_args
   return subprocess.call(cmd, cwd=REPO)
 
 

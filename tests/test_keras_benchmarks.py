@@ -5,6 +5,7 @@ import json
 import os
 
 import numpy as np
+import pytest
 
 from kf_benchmarks_tpu.keras_benchmarks import (data_generator,
                                                 run_benchmark)
@@ -47,6 +48,7 @@ def test_lstm_benchmark_runs():
   assert b.total_time > 0
 
 
+@pytest.mark.slow
 def test_run_benchmark_uploads_metrics(tmp_path):
   sink = str(tmp_path / "metrics.jsonl")
   rows = run_benchmark.run("cpu_config", sink_path=sink)

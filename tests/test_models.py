@@ -26,7 +26,13 @@ def _forward(model, nclass=10, batch=2, train=True):
 
 @pytest.mark.parametrize("name", [
     "trivial", "resnet50", "resnet50_v2", "vgg11", "vgg16", "vgg19",
-    "lenet", "googlenet", "overfeat", "alexnet", "inception3", "inception4",
+    "lenet", "overfeat", "alexnet",
+    # Whole-graph builds of the branchiest families take tens of CPU
+    # seconds each; they ride the slow tier (run_tests.py --full_tests)
+    # so tier-1 stays inside its wall budget.
+    pytest.param("googlenet", marks=pytest.mark.slow),
+    pytest.param("inception3", marks=pytest.mark.slow),
+    pytest.param("inception4", marks=pytest.mark.slow),
 ])
 def test_imagenet_model_forward(name):
   model = model_config.get_model_config(name, "imagenet")
@@ -41,7 +47,8 @@ def test_imagenet_model_forward(name):
 
 
 @pytest.mark.parametrize("name", [
-    "trivial", "resnet20", "resnet20_v2", "alexnet", "densenet40_k12",
+    "trivial", "resnet20", "resnet20_v2", "alexnet",
+    pytest.param("densenet40_k12", marks=pytest.mark.slow),
 ])
 def test_cifar_model_forward(name):
   model = model_config.get_model_config(name, "cifar10")
@@ -61,6 +68,7 @@ def test_official_resnet_forward(name):
   assert jnp.all(jnp.isfinite(logits))
 
 
+@pytest.mark.slow
 def test_nasnetlarge_forward():
   """NASNet-A large variant (ref: models/nasnet_model.py:557-578)."""
   model = model_config.get_model_config("nasnetlarge", "imagenet")
@@ -69,8 +77,10 @@ def test_nasnetlarge_forward():
 
 
 @pytest.mark.parametrize("name,dataset", [
-    ("mobilenet", "imagenet"),        # depthwise/inverted-residual family
-    ("densenet40_k12", "cifar10"),    # dense-concat topology
+    # The mobilenet/densenet backward builds are the two slowest tests
+    # in the whole suite on a CPU box; slow tier.
+    pytest.param("mobilenet", "imagenet", marks=pytest.mark.slow),
+    pytest.param("densenet40_k12", "cifar10", marks=pytest.mark.slow),
     ("official_resnet18", "imagenet"),  # official-models wrapper family
 ])
 def test_model_gradient_step(name, dataset):
@@ -124,6 +134,7 @@ def test_mobilenet_make_divisible():
       assert mobilenet_v2.make_divisible(c * m) >= 0.9 * c * m
 
 
+@pytest.mark.slow
 def test_nasnet_cifar_forward():
   """NASNet-A cifar builds with an aux head feeding the 0.4-weighted
   loss (ref: models/nasnet_model.py:566-578, nasnet_utils cells)."""
@@ -162,6 +173,7 @@ def test_nasnet_drop_path_global_step_ramp():
           float(drop_path_keep_prob(base, 0, total, 1.0)))
 
 
+@pytest.mark.slow
 def test_nasnet_module_accepts_progress():
   """The module threads ``progress`` to every drop-path site; the traced
   scalar must not leak into shapes (jit-compatible ramp)."""

@@ -29,6 +29,56 @@ def test_round_trip_error_bounded_per_channel():
   assert np.all(np.asarray(err) <= np.asarray(scale) / 2 + 1e-7)
 
 
+def test_depthwise_layout_gets_per_in_channel_scales():
+  """TF-layout depthwise kernels (h, w, in, multiplier) spread their
+  output channels over the last TWO axes: reducing over all leading
+  axes would give ONE scale per multiplier slot (multiplier=1: one
+  scale for the whole kernel), collapsing every input channel's
+  dynamic range. The scale must be per (in, multiplier)."""
+  chan_mag = jnp.linspace(0.01, 4.0, 64)  # 400x dynamic range across in
+  w = jax.random.normal(jax.random.PRNGKey(2), (3, 3, 64, 1)) * \
+      chan_mag[None, None, :, None]
+  q = quantization.quantize_variables({"dw": w}, min_elems=1)
+  assert q["dw"]["__scale__"].shape == (64, 1)
+  back = quantization.dequantize_variables(q)["dw"]
+  scale = jnp.max(jnp.abs(w), axis=(0, 1)) / 127.0
+  err = jnp.max(jnp.abs(back - w), axis=(0, 1))
+  # Per-channel bound: err <= scale/2 for EVERY input channel -- a
+  # whole-kernel scale would blow this bound on the small channels by
+  # orders of magnitude.
+  assert np.all(np.asarray(err) <= np.asarray(scale) / 2 + 1e-7)
+
+
+def test_flax_depthwise_layout_keeps_per_channel_scales():
+  # The flax depthwise layout (h, w, 1, channels) already has its output
+  # channels last; the layout heuristic must not touch it.
+  w = jax.random.normal(jax.random.PRNGKey(3), (3, 3, 1, 512))
+  q = quantization.quantize_variables({"dw": w}, min_elems=1)
+  assert q["dw"]["__scale__"].shape == (512,)
+
+
+def test_int8_accuracy_delta_on_depthwise_model():
+  """The accuracy-delta check on a depthwise model (mobilenet_v2): the
+  quantized forward's top-1 decisions agree with the float forward --
+  the depthwise blocks dominate mobilenet, so a mis-scaled depthwise
+  quantizer fails exactly here."""
+  from kf_benchmarks_tpu import quantization as q_lib
+  from kf_benchmarks_tpu.models import model_config
+  model = model_config.get_model_config("mobilenet", "imagenet")
+  model.set_batch_size(2)
+  module = model.make_module(nclass=100, phase_train=False,
+                             data_format="NHWC")
+  images = jax.random.uniform(jax.random.PRNGKey(5), (2, 224, 224, 3))
+  variables = module.init({"params": jax.random.PRNGKey(6)}, images)
+  f_logits, _ = module.apply(variables, images)
+  qvars = q_lib.quantize_variables(variables)
+  assert q_lib.quantized_fraction(qvars) > 0.5
+  q_logits, _ = module.apply(q_lib.dequantize_variables(qvars), images)
+  f32, q32 = np.asarray(f_logits), np.asarray(q_logits)
+  assert np.mean(np.argmax(f32, -1) == np.argmax(q32, -1)) >= 0.75
+  assert np.mean(np.abs(q32 - f32)) < 0.05 * max(np.mean(np.abs(f32)), 1e-3)
+
+
 def test_small_and_nonfloat_leaves_pass_through():
   tree = {
       "bias": jnp.ones((64,)),              # 1-D: never quantized

@@ -312,8 +312,13 @@ print("FLASH_OK")
   env = dict(os.environ)
   env.pop("XLA_FLAGS", None)
   env.pop("JAX_PLATFORMS", None)
+  # NO subprocess timeout: the first-ever Pallas compile over the axon
+  # tunnel can exceed an hour with ~0 host CPU, and a timeout KILL
+  # mid-claim is the documented tunnel-wedge trigger (CLAUDE.md
+  # round-4 incident). A hung run is the operator's call to abandon;
+  # killing it programmatically costs every later process the chip.
   r = subprocess.run([sys.executable, "-c", prog], capture_output=True,
-                     text=True, timeout=3600, env=env, cwd=repo)
+                     text=True, env=env, cwd=repo)
   assert r.returncode == 0 and "FLASH_OK" in r.stdout, (
       r.stdout[-2000:], r.stderr[-2000:])
 

@@ -67,7 +67,7 @@ def _make_step(strategy, mesh, tx=None, **param_overrides):
 
 def _run(strategy, steps=5, tx=None, **param_overrides):
   mesh = build_mesh(N_REPLICAS, "cpu")
-  init_state, train_step, _, broadcast_init = _make_step(
+  init_state, train_step, _, broadcast_init, _ = _make_step(
       strategy, mesh, tx=tx, **param_overrides)
   # Per-replica scalar inputs x_i = i+1, labels y_i = 2*(i+1).
   x = jnp.arange(1, N_REPLICAS + 1, dtype=jnp.float32).reshape(N_REPLICAS, 1)
@@ -189,7 +189,7 @@ def test_staged_buffer_reseeded_on_restore():
                              staged_vars=True,
                              num_devices=N_REPLICAS, device="cpu")
   mesh = build_mesh(N_REPLICAS, "cpu")
-  init_state, train_step, _, _ = _make_step(
+  init_state, train_step, _, _, _ = _make_step(
       strategies.get_strategy(p), mesh, staged_vars=True)
   x = jnp.ones((N_REPLICAS, 1), jnp.float32)
   state = jax.jit(init_state)(jax.random.PRNGKey(0), x[:1])
@@ -295,15 +295,20 @@ def test_pair_average_matches_direct_permutation_all_shifts(
     np.testing.assert_array_equal(out, expect)
 
 
-def test_hypercube_gossip_mixes_within_log2n_steps(monkeypatch):
+@pytest.mark.parametrize("n", [N_REPLICAS, 6])
+def test_hypercube_gossip_mixes_within_log2n_steps(monkeypatch, n):
   """The at-scale schedule's mixing window: starting from a one-hot
   basis, every replica holds mass from EVERY replica after the
   ceil(log2 n) hypercube offsets -- the property that replaces the
-  1..n-1 rotation's n-1-step pairwise guarantee."""
+  1..n-1 rotation's n-1-step pairwise guarantee. Parametrized over a
+  NON-power-of-two submesh (n=6) too: the offsets 2^0..2^(ceil(log2
+  n)-1) are all < n and their subset sums mod n cover every residue,
+  so the ceil(log2 n) window holds at any axis size (kungfu.py
+  gossip_shift docstring)."""
   from jax.sharding import PartitionSpec as P
   monkeypatch.setattr(kungfu, "GOSSIP_SWITCH_MAX_N", 1)
-  mesh = build_mesh(N_REPLICAS, "cpu")
-  n = N_REPLICAS
+  mesh = build_mesh(n, "cpu")
+  assert len(kungfu._gossip_offsets(n)) == (n - 1).bit_length()
   vals = jnp.eye(n, dtype=jnp.float32)
 
   f = jax.jit(jax.shard_map(
