@@ -19,13 +19,52 @@ the classic table).
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import subprocess
 import sys
+import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from experiments.serving_sweep import run_cli  # noqa: E402
+
+
+def run_point(cli, timeout=3600, mfu=False):
+  """One sweep point -> (img/s, mfu or None).
+
+  ``mfu=True`` adds the MFU column: measured FLOP/s / 197 TFLOP/s
+  (VERDICT stretch #9) -- the train program's static flop count from
+  the compiled-HLO cost analysis the CLI dumps under --tfprof_file,
+  times the measured steps/s. OPT-IN because --tfprof_file compiles
+  the step a second time ahead of the jit cache's own compile
+  (benchmark.py logs this), and on the chip a first compile of a
+  novel program can exceed 30 min: doubling compile work inside
+  run_cli's kill-based subprocess timeout is the documented
+  tunnel-wedge trigger (CLAUDE.md). Callers passing mfu=True should
+  size ``timeout`` for two compiles."""
+  if not mfu:
+    return run_cli(cli, timeout=timeout), None
+  # Lazy import so the sweep stays runnable from a bare checkout when
+  # the MFU column is off.
+  from kf_benchmarks_tpu.observability import TPU_PEAK_FLOPS
+  with tempfile.TemporaryDirectory() as td:
+    prof = os.path.join(td, "prof.json")
+    ips = run_cli(cli + [f"--tfprof_file={prof}"], timeout=timeout)
+    flops = None
+    try:
+      with open(prof) as f:
+        flops = json.load(f).get("cost_analysis", {}).get("flops")
+    except (OSError, ValueError):
+      pass
+  bs = next((int(a.split("=")[1]) for a in cli
+             if a.startswith("--batch_size=")), None)
+  # No explicit --batch_size (model default resolved inside the CLI):
+  # steps/s is unknowable here, so the point keeps its img/s and just
+  # drops the MFU cell rather than discarding a completed chip run.
+  if not (flops and bs):
+    return ips, None
+  return ips, flops * (ips / bs) / TPU_PEAK_FLOPS
 
 # (model, batch_size, extra CLI args)
 ZOO = [
@@ -64,6 +103,10 @@ def main():
   ap.add_argument("--warmup", type=int, default=5)
   ap.add_argument("--only", nargs="*", default=None)
   ap.add_argument("--device", default="tpu")
+  ap.add_argument("--mfu", action="store_true",
+                  help="add the measured-MFU column (costs a second "
+                       "compile per point via --tfprof_file; the "
+                       "timeout doubles to cover it)")
   args = ap.parse_args()
 
   if args.only:
@@ -84,24 +127,28 @@ def main():
            "--use_fp16=true", "--optimizer=momentum",
            "--display_every=10"] + extra
     try:
-      ips = run_cli(cli, timeout=3600)
+      ips, mfu = run_point(cli, timeout=7200 if args.mfu else 3600,
+                           mfu=args.mfu)
     except (RuntimeError, subprocess.TimeoutExpired) as e:
       # A single slow/failed point must not discard the completed
       # serialized TPU runs -- record it and keep sweeping.
       print(f"{model}: FAILED -- {e}", flush=True)
-      rows.append((model, bs, None))
+      rows.append((model, bs, None, None))
       continue
-    rows.append((model, bs, ips))
+    rows.append((model, bs, ips, mfu))
     print(f"{model} bs={bs}: {ips:.0f} img/s "
-          f"({1e3 * bs / ips:.2f} ms/step)", flush=True)
+          f"({1e3 * bs / ips:.2f} ms/step"
+          + (f", MFU {100 * mfu:.1f}%" if mfu else "") + ")",
+          flush=True)
 
-  print("\n| model | bs | img/s | ms/step |")
-  print("|---|---|---|---|")
-  for model, bs, ips in rows:
+  print("\n| model | bs | img/s | ms/step | MFU |")
+  print("|---|---|---|---|---|")
+  for model, bs, ips, mfu in rows:
     if ips is None:
-      print(f"| {model} | {bs} | failed | - |")
+      print(f"| {model} | {bs} | failed | - | - |")
     else:
-      print(f"| {model} | {bs} | {ips:.0f} | {1e3 * bs / ips:.2f} |")
+      print(f"| {model} | {bs} | {ips:.0f} | {1e3 * bs / ips:.2f} | "
+            + (f"{100 * mfu:.1f}% |" if mfu else "- |"))
 
 
 if __name__ == "__main__":

@@ -292,6 +292,15 @@ class BenchmarkCNN:
     if params.batch_size:
       self.model.set_batch_size(params.batch_size)
     self.batch_size_per_device = self.model.get_batch_size()
+    gacc = int(params.num_grad_accum or 1)
+    if gacc > 1 and self.batch_size_per_device % gacc:
+      # validation.py checked an EXPLICIT --batch_size; a model-default
+      # batch resolves here, so the divisibility contract is re-checked
+      # against the resolved value.
+      raise validation.ParamError(
+          f"--num_grad_accum={gacc} must divide the per-device batch "
+          f"size {self.batch_size_per_device} (model default for "
+          f"{self.model.get_name()}); pass a divisible --batch_size")
     self.num_devices = params.num_devices
     self.batch_size = self.batch_size_per_device * self.num_devices
     # Multi-process (multi-host) runs multiply further (ref num_workers).
@@ -699,6 +708,19 @@ class BenchmarkCNN:
             "variables of this model (wrong checkpoint?)")
       log_fn(f"Loaded {n_restored} backbone tensors from "
              f"{p.backbone_model_path}")
+    if int(p.num_grad_accum or 1) > 1 and jax.tree.leaves(
+        state.batch_stats):
+      # Microbatched BN is standard Megatron-style semantics, but it is
+      # a semantics CHANGE, not a pure memory lever: each microbatch
+      # normalizes over batch/M samples and the running-stats EMA
+      # advances M times per step. Losses/accuracy are NOT expected to
+      # match the M=1 run for batch-norm models -- say so up front
+      # rather than letting an operator chase a phantom regression.
+      log_fn(f"Note: --num_grad_accum={p.num_grad_accum} with a "
+             "batch-norm model: BN statistics are per-microbatch "
+             f"(batch/{p.num_grad_accum}) and running stats update "
+             f"{p.num_grad_accum}x per step; not numerically "
+             "equivalent to the monolithic step (BN-free models are)")
     # Replica-0 broadcast at start (ref: benchmark_cnn.py:2094-2100).
     state = state.replace(params=broadcast_init(state.params))
     # Resolve the broadcast so the reported initialization time covers
@@ -778,6 +800,13 @@ class BenchmarkCNN:
             steps_per_dispatch=self.steps_per_dispatch)
         for line in table.splitlines():
           log_fn(line)
+        try:
+          # The footprint the HBM levers (--num_grad_accum, the
+          # chunked fused head, scanned-layer remat) actually move.
+          log_fn(observability.hbm_breakdown_line(
+              compiled.memory_analysis()))
+        except Exception as e:  # backend-dependent surface
+          log_fn(f"peak HBM line unavailable: {e!r}")
       if p.partitioned_graph_file_prefix:
         path = p.partitioned_graph_file_prefix + ".txt"
         observability.dump_partitioned_text(compiled, path)

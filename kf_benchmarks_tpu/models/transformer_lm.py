@@ -16,6 +16,23 @@ materialised-score attention at every length). Synthetic data follows
 the NCF/DeepSpeech pattern: int32 token ids ride the feature slot,
 next-token ids the label slot; throughput prints as sequences/sec on
 the standard step line (x seq_len for tokens/sec).
+
+HBM footprint (the round-7 pass; PERF.md):
+
+* The L identical blocks run as ONE scanned layer (nn.scan) with
+  ``jax.checkpoint`` per block (nn.remat), so the compiled program
+  carries one block body instead of L copies and the backward pass
+  keeps one block-boundary residual per layer instead of every
+  intermediate.
+* The LM head never materializes the (B, T, V) logits tensor: the
+  module returns ``ops.fused_loss.FusedLMHead`` (final hidden states +
+  unembedding kernel) and the loss/accuracy functions reduce it chunk
+  at a time (peak temp O(B*chunk*V); bit-exact against the monolithic
+  head, tests/test_fused_loss.py).
+
+Both levers are env-switchable for on-chip A/Bs:
+KF_TRANSFORMER_LM_HEAD in ('fused', 'dense'),
+KF_TRANSFORMER_LM_LAYERS in ('scan', 'loop').
 """
 
 from __future__ import annotations
@@ -27,6 +44,7 @@ import jax.numpy as jnp
 import flax.linen as nn
 
 from kf_benchmarks_tpu.models import model as model_lib
+from kf_benchmarks_tpu.ops import fused_loss as fused_loss_lib
 from kf_benchmarks_tpu.parallel import sequence as sequence_lib
 
 VOCAB = 32768
@@ -41,6 +59,61 @@ ATTN_BLOCK = 512
 ATTN_Q_BLOCK = 512
 
 
+class _Block(nn.Module):
+  """One pre-LN decoder block; the unit nn.scan stacks L-fold.
+
+  The (carry, None) -> (carry, None) signature is the nn.scan contract;
+  the loop fallback calls it with the same shape so the two layer
+  paths share one body (and therefore cannot drift numerically).
+  """
+  d_model: int
+  n_heads: int
+  d_ff: int
+  attn_block: int
+  attn_q_block: int
+  attn_impl: str
+  dtype: Any
+  param_dtype: Any
+
+  @nn.compact
+  def __call__(self, x, _):
+    b, t, _d = x.shape
+    head_dim = self.d_model // self.n_heads
+    dense = lambda feats, name, bias=True: nn.Dense(
+        feats, use_bias=bias, name=name, dtype=self.dtype,
+        param_dtype=self.param_dtype)
+    # LayerNorm computes in f32 (bf16 mean/variance loses too much);
+    # the surrounding denses cast back down.
+    ln = lambda name: nn.LayerNorm(name=name, dtype=jnp.float32,
+                                   param_dtype=self.param_dtype)
+    h = ln("ln1")(x).astype(self.dtype)
+    qkv = dense(3 * self.d_model, "qkv", bias=False)(h)
+    qkv = qkv.reshape(b, t, 3, self.n_heads, head_dim)
+    blk = min(self.attn_block, t)
+    if self.attn_impl == "flash":
+      # Matched tilings: the A/B against the tiled path must not
+      # confound kernel choice with tile size, so the kernel gets
+      # the same block as the scan (long_context_probe.py ditto).
+      att = sequence_lib.pallas_flash_attention(
+          qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], causal=True,
+          block=blk)
+    elif self.attn_impl == "tiled":
+      att = sequence_lib.blockwise_attention(
+          qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+          block_size=blk, causal=True,
+          q_block_size=min(self.attn_q_block, t))
+    else:
+      raise ValueError(
+          f"attn_impl must be 'tiled' or 'flash', got "
+          f"{self.attn_impl!r}")
+    x = x + dense(self.d_model, "attn_out")(
+        att.reshape(b, t, self.d_model))
+    h = ln("ln2")(x).astype(self.dtype)
+    h = nn.gelu(dense(self.d_ff, "mlp_up")(h))
+    x = x + dense(self.d_model, "mlp_down")(h)
+    return x, None
+
+
 class _TransformerLMModule(nn.Module):
   vocab: int = VOCAB
   d_model: int = D_MODEL
@@ -52,6 +125,16 @@ class _TransformerLMModule(nn.Module):
   # 'tiled' (XLA two-level scan) or 'flash' (the TPU Pallas kernel) --
   # switchable per run via KF_TRANSFORMER_LM_ATTN for on-chip A/Bs.
   attn_impl: str = "tiled"
+  # True: ONE scanned+rematerialized block (params carry a leading
+  # layer axis under 'blocks'); False: the unrolled per-layer loop
+  # (params under 'block_{i}') -- the equivalence oracle and the
+  # program-size A/B.
+  scan_layers: bool = True
+  # True: return ops.fused_loss.FusedLMHead (hidden, kernel) so the
+  # loss reduces chunk-wise without a (B, T, V) tensor; False:
+  # materialize logits (the monolithic head the oracle tests pin
+  # against).
+  fused_head: bool = True
   max_len: int = SEQ_LEN
   dtype: Any = jnp.float32
   param_dtype: Any = jnp.float32
@@ -60,14 +143,11 @@ class _TransformerLMModule(nn.Module):
   def __call__(self, tokens):
     tokens = tokens.astype(jnp.int32)
     b, t = tokens.shape
-    head_dim = self.d_model // self.n_heads
-    dense = lambda feats, name, bias=True: nn.Dense(
-        feats, use_bias=bias, name=name, dtype=self.dtype,
+    block_kwargs = dict(
+        d_model=self.d_model, n_heads=self.n_heads, d_ff=self.d_ff,
+        attn_block=self.attn_block, attn_q_block=self.attn_q_block,
+        attn_impl=self.attn_impl, dtype=self.dtype,
         param_dtype=self.param_dtype)
-    # LayerNorm computes in f32 (bf16 mean/variance loses too much);
-    # the surrounding denses cast back down.
-    ln = lambda name: nn.LayerNorm(name=name, dtype=jnp.float32,
-                                   param_dtype=self.param_dtype)
 
     x = nn.Embed(self.vocab, self.d_model, name="embed",
                  dtype=self.dtype, param_dtype=self.param_dtype)(tokens)
@@ -77,40 +157,35 @@ class _TransformerLMModule(nn.Module):
         (self.max_len, self.d_model))
     x = x + pos[:t].astype(self.dtype)
 
-    for i in range(self.n_layers):
-      h = ln(f"ln1_{i}")(x).astype(self.dtype)
-      qkv = dense(3 * self.d_model, f"qkv_{i}", bias=False)(h)
-      qkv = qkv.reshape(b, t, 3, self.n_heads, head_dim)
-      blk = min(self.attn_block, t)
-      if self.attn_impl == "flash":
-        # Matched tilings: the A/B against the tiled path must not
-        # confound kernel choice with tile size, so the kernel gets
-        # the same block as the scan (long_context_probe.py ditto).
-        att = sequence_lib.pallas_flash_attention(
-            qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], causal=True,
-            block=blk)
-      elif self.attn_impl == "tiled":
-        att = sequence_lib.blockwise_attention(
-            qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
-            block_size=blk, causal=True,
-            q_block_size=min(self.attn_q_block, t))
-      else:
-        raise ValueError(
-            f"attn_impl must be 'tiled' or 'flash', got "
-            f"{self.attn_impl!r}")
-      x = x + dense(self.d_model, f"attn_out_{i}")(
-          att.reshape(b, t, self.d_model))
-      h = ln(f"ln2_{i}")(x).astype(self.dtype)
-      h = nn.gelu(dense(self.d_ff, f"mlp_up_{i}")(h))
-      x = x + dense(self.d_model, f"mlp_down_{i}")(h)
+    if self.scan_layers:
+      # One block body in the compiled program regardless of depth;
+      # jax.checkpoint per block (nn.remat) keeps only the block
+      # boundaries as backward residuals. prevent_cse=False is the
+      # scan-safe setting (the scan barrier already blocks the CSE
+      # that prevent_cse guards against; True pessimizes TPU code).
+      blocks = nn.scan(
+          nn.remat(_Block, prevent_cse=False),
+          variable_axes={"params": 0},
+          split_rngs={"params": True},
+          length=self.n_layers)(name="blocks", **block_kwargs)
+      x, _ = blocks(x, None)
+    else:
+      for i in range(self.n_layers):
+        x, _ = _Block(name=f"block_{i}", **block_kwargs)(x, None)
 
-    x = ln("ln_f")(x)
+    x = nn.LayerNorm(name="ln_f", dtype=jnp.float32,
+                     param_dtype=self.param_dtype)(x)
     # The head computes in the model dtype: at 32k vocab an f32 logits
     # tensor is the HBM peak (measured OOM at bs=8 on 16 GB, PERF.md);
     # the loss upcasts per sequence chunk instead.
-    logits = nn.Dense(self.vocab, use_bias=False, name="lm_head",
-                      dtype=self.dtype,
-                      param_dtype=self.param_dtype)(x.astype(self.dtype))
+    w_head = self.param("lm_head", nn.initializers.lecun_normal(),
+                        (self.d_model, self.vocab), self.param_dtype)
+    if self.fused_head:
+      # No logits here at ALL: the head matmul itself is deferred into
+      # the chunked loss/accuracy reductions (ops/fused_loss.py).
+      return fused_loss_lib.FusedLMHead(
+          hidden=x.astype(self.dtype), kernel=w_head), None
+    logits = x.astype(self.dtype) @ w_head.astype(self.dtype)
     return logits, None
 
 
@@ -131,8 +206,20 @@ class TransformerLMModel(model_lib.Model):
       raise ValueError(
           f"KF_TRANSFORMER_LM_ATTN must be 'tiled' or 'flash', got "
           f"{impl!r}")
+    head = os.environ.get("KF_TRANSFORMER_LM_HEAD", "fused")
+    if head not in ("fused", "dense"):
+      raise ValueError(
+          f"KF_TRANSFORMER_LM_HEAD must be 'fused' or 'dense', got "
+          f"{head!r}")
+    layers = os.environ.get("KF_TRANSFORMER_LM_LAYERS", "scan")
+    if layers not in ("scan", "loop"):
+      raise ValueError(
+          f"KF_TRANSFORMER_LM_LAYERS must be 'scan' or 'loop', got "
+          f"{layers!r}")
     return _TransformerLMModule(dtype=dtype, param_dtype=param_dtype,
-                                attn_impl=impl)
+                                attn_impl=impl,
+                                fused_head=head == "fused",
+                                scan_layers=layers == "scan")
 
   def get_input_shapes(self, subset):
     n = self.get_batch_size()
@@ -156,14 +243,18 @@ class TransformerLMModel(model_lib.Model):
   LOSS_CHUNK = 256
 
   def loss_function(self, build_network_result, labels):
-    logits, _ = build_network_result.logits
+    out, _ = build_network_result.logits
     labels = labels.astype(jnp.int32)
+    if isinstance(out, fused_loss_lib.FusedLMHead):
+      # Fused head: loss straight from (hidden, kernel); no logits
+      # tensor exists anywhere in the step (ops/fused_loss.py).
+      return fused_loss_lib.fused_softmax_xent(
+          out.hidden, out.kernel, labels, chunk_size=self.LOSS_CHUNK)
+    # Dense-head fallback: logits are materialized; chunk the softmax
+    # reduction only (the round-6 bounded-memory path).
+    logits = out
     b, t, v = logits.shape
-    # Largest divisor of t within LOSS_CHUNK, so the bounded-memory
-    # guarantee holds for EVERY sequence length (never a silent
-    # full-tensor fallback; worst case chunk=1).
-    chunk = max(c for c in range(1, min(self.LOSS_CHUNK, t) + 1)
-                if t % c == 0)
+    chunk = fused_loss_lib.chunk_of(t, self.LOSS_CHUNK)
     lc = logits.reshape(b, t // chunk, chunk, v).swapaxes(0, 1)
     yc = labels.reshape(b, t // chunk, chunk).swapaxes(0, 1)
 
@@ -180,8 +271,12 @@ class TransformerLMModel(model_lib.Model):
     return -total / (b * t)
 
   def accuracy_function(self, build_network_result, labels):
-    logits, _ = build_network_result.logits
+    out, _ = build_network_result.logits
     labels = labels.astype(jnp.int32)
+    if isinstance(out, fused_loss_lib.FusedLMHead):
+      return fused_loss_lib.fused_top_k_accuracy(
+          out.hidden, out.kernel, labels, chunk_size=self.LOSS_CHUNK)
+    logits = out
     # argmax/top_k reduce away the vocab axis chunk-free (no f32
     # upcast of the full logits tensor is ever materialised).
     top1 = jnp.mean((jnp.argmax(logits, -1) == labels).astype(

@@ -18,6 +18,11 @@ e.g. ``psum:32k:rsag#2`` -- tensors under 32KiB all-reduce directly
 reduce-scatter + all-gather (bandwidth-optimal on an ICI ring, the analog
 of the reference's ``xring``).
 
+``hier`` is UNVALIDATED AT SCALE (only ever measured on single-chip /
+virtual meshes; VERDICT weak #4): the default remains ``psum``, and
+selecting hier on a single-process mesh logs a warning at build time
+(_warn_hier_selected).
+
 Reference algorithm names map onto TPU implementations so reference specs
 keep working: nccl->psum, xring->rsag, pscpu/psgpu->psum,
 collective->psum, nccl/xring & friends->hier.
@@ -415,6 +420,26 @@ def hier_reduce(grads, axis_name, num_groups: int = 2, compact_dtype=None,
   return jax.tree.map(one, grads)
 
 
+def _warn_hier_selected(source: str) -> None:
+  """One-line operator warning at hier selection time.
+
+  The 'hier' algorithm is UNVALIDATED AT SCALE: its two-level ring
+  decomposition has only ever been measured on the single-chip /
+  virtual-mesh configurations this repo can reach (PERF.md; VERDICT
+  weak #4) -- the default remains psum, which XLA lowers to
+  topology-aware ICI rings itself. On a single-process mesh the
+  process/host boundary hier exists to exploit does not exist, so the
+  decomposition can only add latency over the fused psum."""
+  from kf_benchmarks_tpu.utils import log as log_util
+  if jax.process_count() > 1:
+    return
+  log_util.log_fn(
+      f"Warning: 'hier' all-reduce selected ({source}) on a "
+      "single-process mesh: the two-level decomposition is unvalidated "
+      "at scale and has no host boundary to exploit here -- the psum "
+      "default is the measured-fast path (PERF.md)")
+
+
 def build_reducer(params):
   """Flag-selected gradient reducer for the replicated-family strategies,
   or None for the direct-pmean default (ref selection:
@@ -440,6 +465,7 @@ def build_reducer(params):
     # multi-process mesh, so the intra-group ring rides ICI; num_groups
     # defaults to the process count there and to the reference's 2-group
     # shape single-process (ref: batch_allreduce.py:173-267).
+    _warn_hier_selected("--hierarchical_copy")
     from kf_benchmarks_tpu.parallel import mesh as mesh_lib
     devices = mesh_lib.get_devices(params.device, params.num_devices)
     groups = topology_groups(devices, num_groups=jax.process_count()
@@ -456,6 +482,8 @@ def build_planner(params) -> Optional[CollectivePlanner]:
   if not params.all_reduce_spec:
     return None
   tuples = parse_all_reduce_spec(params.all_reduce_spec)
+  if any(t.alg == "hier" for t in tuples):
+    _warn_hier_selected(f"--all_reduce_spec={params.all_reduce_spec}")
   compact = jnp.bfloat16 if (params.compact_gradient_transfer and
                              params.use_fp16) else None
   return CollectivePlanner(tuples, num_replicas_hint=params.num_devices,

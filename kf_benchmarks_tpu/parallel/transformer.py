@@ -119,6 +119,51 @@ def param_specs(params) -> Dict[str, Any]:
   return {"embed": P(), "pos": P(), "ln_f": P(), "blocks": blocks}
 
 
+def stack_blocks(params):
+  """Per-layer block list -> ONE stacked block pytree (leading layer
+  axis on every leaf), the layout the scan-over-layers path consumes.
+
+  Requires a homogeneous (dense) stack: MoE blocks are heterogeneous
+  under moe_every and their capacity queues are per data shard -- the
+  same restriction to_pipelined() enforces for the stage axis.
+  """
+  blocks = params["blocks"]
+  if any("gate_w" in b for b in blocks):
+    raise ValueError(
+        "scan-over-layers requires a homogeneous (dense) layer stack; "
+        "MoE blocks are heterogeneous -- use the unscanned "
+        "make_train_step for dp x sp x tp x ep")
+  out = {k: v for k, v in params.items() if k != "blocks"}
+  out["blocks"] = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+  return out
+
+
+def unstack_blocks(params):
+  """Inverse of stack_blocks (so trained scanned state compares
+  leaf-for-leaf against the per-layer oracle's)."""
+  stacked = params["blocks"]
+  n_layers = jax.tree.leaves(stacked)[0].shape[0]
+  blocks = [jax.tree.map(lambda x: x[i], stacked)
+            for i in range(n_layers)]
+  out = {k: v for k, v in params.items() if k != "blocks"}
+  out["blocks"] = blocks
+  return out
+
+
+def stacked_param_specs():
+  """Specs for the stacked tree: a leading (replicated) layer axis on
+  every block leaf; the tensor axis stays on the same dims as
+  param_specs, shifted by one."""
+  blocks = {
+      "ln1": P(None), "ln2": P(None),
+      "wqkv": P(None, None, None, TENSOR_AXIS),
+      "wo": P(None, TENSOR_AXIS),
+      "w1": P(None, None, TENSOR_AXIS), "b1": P(None, TENSOR_AXIS),
+      "w2": P(None, TENSOR_AXIS, None), "b2": P(None),
+  }
+  return {"embed": P(), "pos": P(), "ln_f": P(), "blocks": blocks}
+
+
 def _rmsnorm(x, scale, eps=1e-6):
   x = x.astype(jnp.float32)
   return (x * jax.lax.rsqrt(jnp.mean(x * x, -1, keepdims=True) + eps)
@@ -187,7 +232,7 @@ def _attention_residual(lp, x, *, seq_axis, tensor_axis, sp_layout,
 def forward_local(params, tokens, *, seq_axis=SEQ_AXIS,
                   tensor_axis=TENSOR_AXIS, expert_axis=REPLICA_AXIS,
                   moe_capacity=None, sp_layout: str = "contiguous",
-                  attn_inner_block=None):
+                  attn_inner_block=None, remat_policy=None):
   """Per-shard forward: tokens (B_local, T_local) -> (logits, moe_aux).
 
   Runs inside a shard_map body; params are the LOCAL shards
@@ -199,11 +244,38 @@ def forward_local(params, tokens, *, seq_axis=SEQ_AXIS,
   sp_layout='zigzag' expects the sequence axis sharded in
   sequence.zigzag_order (stripe pair (idx, 2n-1-idx) per device) and
   runs the load-balanced causal ring; positions follow the stripes.
+
+  A ``params['blocks']`` that is a stack_blocks() pytree (leading layer
+  axis) instead of a per-layer list runs the layer stack as ONE
+  ``lax.scan`` body under ``jax.checkpoint`` -- compiled-program size
+  and saved-residual footprint O(1) in depth instead of O(L).
+  ``remat_policy`` is the explicit jax.checkpoint policy for that path
+  (None = save nothing, recompute the whole block;
+  e.g. jax.checkpoint_policies.dots_with_no_batch_dims_saveable keeps
+  the matmul outputs and recomputes only the cheap elementwise work).
   """
   b, t = tokens.shape
   x = _embed_positions(params, tokens, seq_axis=seq_axis,
                        sp_layout=sp_layout)
   moe_aux = jnp.zeros((), jnp.float32)
+  if not isinstance(params["blocks"], (list, tuple)):
+    # Scanned stack (homogeneous by stack_blocks construction).
+    def one_block(xm, lp):
+      xm, h = _attention_residual(lp, xm, seq_axis=seq_axis,
+                                  tensor_axis=tensor_axis,
+                                  sp_layout=sp_layout,
+                                  attn_inner_block=attn_inner_block)
+      xm = xm + tp_lib.parallel_mlp(h, lp["w1"], lp["b1"], lp["w2"],
+                                    lp["b2"], axis_name=tensor_axis)
+      return xm, None
+
+    body = jax.checkpoint(one_block, policy=remat_policy,
+                          prevent_cse=False)
+    x, _ = lax.scan(body, x, params["blocks"])
+    x = _rmsnorm(x, params["ln_f"])
+    logits = jnp.einsum("btd,vd->btv", x,
+                        params["embed"].astype(jnp.float32))
+    return logits, moe_aux
   for lp in params["blocks"]:
     d_model = lp["wqkv"].shape[0]
     x, h = _attention_residual(lp, x, seq_axis=seq_axis,
@@ -344,7 +416,8 @@ def build_mesh(n_replica: int, n_seq: int, n_tensor: int,
 def make_train_step(mesh: Mesh, params_template, learning_rate: float,
                     moe_capacity=None, moe_aux_weight: float = 0.01,
                     sp_layout: str = "contiguous",
-                    attn_inner_block=None):
+                    attn_inner_block=None, scan_layers: bool = False,
+                    remat_policy=None):
   """Jitted SGD train step over GLOBAL (params, tokens, labels):
   tokens/labels (batch, seq) in NORMAL order, sharded (replica, seq);
   params per param_specs. MoE blocks (if any in the template) add
@@ -354,10 +427,23 @@ def make_train_step(mesh: Mesh, params_template, learning_rate: float,
   load-balanced causal ring (input pipelines that store sequences
   pre-permuted should shard_map forward_local directly). Returns
   (new_params, loss) -- the token-mean loss is permutation-invariant,
-  so the layout never leaks to the caller."""
+  so the layout never leaks to the caller.
+
+  scan_layers=True expects a stack_blocks() params tree and runs the
+  layer stack as one scanned+rematerialized body (forward_local);
+  ``remat_policy`` is its explicit jax.checkpoint policy. Losses and
+  trained parameters stay numerically equivalent to the unscanned
+  step (tests/test_transformer_parallel.py pins it)."""
   if sp_layout not in ("contiguous", "zigzag"):
     raise ValueError(f"unknown sp_layout {sp_layout!r}")
-  specs = param_specs(params_template)
+  if scan_layers:
+    if isinstance(params_template["blocks"], (list, tuple)):
+      raise ValueError(
+          "scan_layers=True takes a stack_blocks() params tree "
+          "(leading layer axis), not the per-layer block list")
+    specs = stacked_param_specs()
+  else:
+    specs = param_specs(params_template)
   data_spec = P(REPLICA_AXIS, SEQ_AXIS)
   n_data = mesh.shape[REPLICA_AXIS] * mesh.shape[SEQ_AXIS]
   n_seq = mesh.shape[SEQ_AXIS]
@@ -366,7 +452,8 @@ def make_train_step(mesh: Mesh, params_template, learning_rate: float,
     def local_loss(p):
       logits, moe_aux = forward_local(
           p, tokens, moe_capacity=moe_capacity, sp_layout=sp_layout,
-          attn_inner_block=attn_inner_block)
+          attn_inner_block=attn_inner_block,
+          remat_policy=remat_policy)
       return (_loss_from_logits(logits, labels)
               + moe_aux_weight * moe_aux)
 

@@ -39,6 +39,24 @@ flags.DEFINE_integer("steps_per_dispatch", 1,
                      "losses are unchanged; wall-clock timing is honest "
                      "at chunk granularity (utils/pipeline.py).",
                      lower_bound=1)
+flags.DEFINE_integer("num_grad_accum", 1,
+                     "Gradient accumulation: split each per-device batch "
+                     "into M microbatches scanned inside the train step, "
+                     "accumulating gradients in f32 before ONE gradient "
+                     "collective and ONE optimizer apply (Megatron-style "
+                     "microbatching, Shoeybi et al. 2019 -- no reference "
+                     "analog; its per-GPU towers never exceeded memory). "
+                     "Backward-pass activation residuals shrink to "
+                     "batch/M; per-device batch size must be divisible by "
+                     "M (validation.py). Batch-norm models note: BN "
+                     "statistics are computed per MICROBATCH (batch/M "
+                     "samples) and the running-stats EMA advances M times "
+                     "per step -- standard microbatching semantics, NOT "
+                     "numerically equivalent to M=1 for BN models (a "
+                     "run-time note is logged). Composes with "
+                     "--steps_per_dispatch (dispatch chunking outside, "
+                     "microbatching inside). 1 = the monolithic step.",
+                     lower_bound=1)
 flags.DEFINE_integer("num_batches", None,
                      "Number of timed batches to run (ref :137-139).")
 flags.DEFINE_float("num_epochs", None,

@@ -129,6 +129,13 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
   the carry is the ordinary TrainState, so step numbering, the
   fold_in(rng, step) dropout stream, LR schedules, and the loss-scale
   state machine advance exactly as in K dispatches of ``train_step``.
+
+  ``--num_grad_accum=M`` > 1 microbatches INSIDE each train step (an
+  inner lax.scan over M batch slices accumulating f32 gradients before
+  one reduction + one optimizer apply), orthogonal to the K-step
+  dispatch chunking outside: K amortizes host/dispatch cost, M bounds
+  backward-residual HBM. Both default off (the exact monolithic
+  program).
   """
   num_replicas = mesh.devices.size
   weight_decay = params.weight_decay or 0.0
@@ -157,6 +164,12 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
   relaxed = getattr(params, "variable_consistency", "strong") == "relaxed"
   steps_per_dispatch = int(
       getattr(params, "steps_per_dispatch", None) or 1)
+  # --num_grad_accum=M: the step scans M microbatches (leading batch
+  # split) accumulating gradients in f32 before ONE reduction collective
+  # and ONE optimizer apply -- the Megatron-style memory lever (Shoeybi
+  # et al. 2019): backward residuals are sized to B/M instead of B.
+  # M=1 keeps the exact monolithic program (the PERF.md envelope).
+  num_grad_accum = int(getattr(params, "num_grad_accum", None) or 1)
   # Modules with a training-progress schedule (NASNet drop-path's
   # global-step ramp, ref: nasnet_utils.py:407-439) take ``progress`` =
   # step / total_training_steps; total steps is the run's --num_batches.
@@ -227,17 +240,17 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
       apply_kwargs["progress"] = (
           state.step.astype(jnp.float32) / total_train_steps)
 
-    def loss_fn(p):
+    def loss_fn(p, mb_images, mb_labels, bs, dropout_rng):
       variables = {"params": p}
-      if batch_stats:
-        variables["batch_stats"] = batch_stats
+      if bs:
+        variables["batch_stats"] = bs
       (logits, aux_logits), updates = module.apply(
-          variables, images, mutable=["batch_stats"],
-          rngs={"dropout": step_rng}, **apply_kwargs)
-      new_bs = updates.get("batch_stats", batch_stats)
+          variables, mb_images, mutable=["batch_stats"],
+          rngs={"dropout": dropout_rng}, **apply_kwargs)
+      new_bs = updates.get("batch_stats", bs)
       from kf_benchmarks_tpu.models.model import BuildNetworkResult
       result = BuildNetworkResult(logits=(logits, aux_logits))
-      base_loss = model.loss_function(result, labels)
+      base_loss = model.loss_function(result, mb_labels)
       total_loss = base_loss
       if weight_decay:
         total_loss = total_loss + weight_decay * l2_loss(
@@ -245,8 +258,86 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
       scaled = total_loss * state.loss_scale
       return scaled, (base_loss, total_loss, new_bs, result)
 
-    grads, (base_loss, total_loss, new_bs, net_result) = jax.grad(
-        loss_fn, has_aux=True)(forward_params)
+    accum_acc_metrics = None
+    if num_grad_accum > 1:
+      # Microbatched accumulation (--num_grad_accum=M): one scan
+      # iteration per microbatch, so the compiled program carries ONE
+      # microbatch-sized forward+backward regardless of M, and XLA
+      # reuses that iteration's activation buffers M times. Gradients
+      # accumulate in f32 (the master precision) and are divided once,
+      # so the accumulated gradient is the mean over microbatches --
+      # the same estimator as the monolithic step up to float
+      # reassociation of the batch reduction. Everything downstream
+      # (ONE strategy reduction, the loss-scale state machine, the
+      # optimizer apply) sees exactly one gradient tree per step.
+      m = num_grad_accum
+      if images.shape[0] % m:
+        raise ValueError(
+            f"--num_grad_accum={m} must divide the per-replica batch "
+            f"size {images.shape[0]} (validation.py admits only "
+            "configurations where it can)")
+      split = lambda x: x.reshape((m, x.shape[0] // m) + x.shape[1:])
+      mb_images = split(images)
+      mb_labels = jax.tree.map(split, labels)
+      grad_fn = jax.grad(loss_fn, has_aux=True)
+      want_acc = bool(params.print_training_accuracy)
+      # Scan carries start as zeros; inside the shard_map body the
+      # gradients/metrics they accumulate are device-varying, so the
+      # zeros are pcast to match (identity on pre-vma jax; sequence.py).
+      from kf_benchmarks_tpu.parallel import sequence as sequence_lib
+
+      def _vary(tree):
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        return jax.tree_util.tree_unflatten(
+            treedef,
+            list(sequence_lib.vary_like(images, tuple(leaves))))
+
+      g0 = _vary(jax.tree.map(
+          lambda p: jnp.zeros(p.shape, jnp.float32), forward_params))
+      bl0, tl0 = _vary((jnp.zeros((), jnp.float32),
+                        jnp.zeros((), jnp.float32)))
+      bs0 = _vary(batch_stats)
+
+      def mb_body(carry, xs):
+        g_acc, bl_acc, tl_acc, acc_acc, bs = carry
+        imgs, lbls, idx = xs
+        # Distinct dropout stream per microbatch (a shared one would
+        # correlate masks across the effective batch).
+        rng_i = jax.random.fold_in(step_rng, idx)
+        g, (bl, tl, bs_next, result) = grad_fn(forward_params, imgs,
+                                               lbls, bs, rng_i)
+        g_acc = jax.tree.map(lambda a, x: a + x.astype(jnp.float32),
+                             g_acc, g)
+        if acc_acc is not None:
+          mb_acc = model.accuracy_function(result, lbls)
+          acc_acc = {k: acc_acc[k] + v for k, v in mb_acc.items()
+                     if k in acc_acc}
+        return (g_acc, bl_acc + bl, tl_acc + tl, acc_acc, bs_next), None
+
+      acc0 = None
+      if want_acc:
+        # Keys from an abstract eval (no FLOPs): scalar metrics only.
+        lb0 = jax.tree.map(lambda x: x[0], mb_labels)
+        shapes = jax.eval_shape(
+            lambda: model.accuracy_function(
+                loss_fn(forward_params, mb_images[0], lb0,
+                        batch_stats, step_rng)[1][3], lb0))
+        acc0 = _vary({k: jnp.zeros((), jnp.float32)
+                      for k, v in shapes.items() if not v.shape})
+      (g_acc, bl_acc, tl_acc, acc_acc, new_bs), _ = lax.scan(
+          mb_body, (g0, bl0, tl0, acc0, bs0),
+          (mb_images, mb_labels, jnp.arange(m)))
+      grads = jax.tree.map(lambda a, p: (a / m).astype(p.dtype),
+                           g_acc, forward_params)
+      base_loss = bl_acc / m
+      total_loss = tl_acc / m
+      net_result = None
+      if acc_acc is not None:
+        accum_acc_metrics = {k: v / m for k, v in acc_acc.items()}
+    else:
+      grads, (base_loss, total_loss, new_bs, net_result) = jax.grad(
+          loss_fn, has_aux=True)(forward_params, images, labels,
+                                 batch_stats, step_rng)
     if use_loss_scale or auto_loss_scale:
       grads = jax.tree.map(lambda g: g / state.loss_scale, grads)
     noise_stats = None
@@ -365,7 +456,11 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
           jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
                        for g in jax.tree.leaves(grads))), REPLICA_AXIS)
     if params.print_training_accuracy:
-      acc = model.accuracy_function(net_result, labels)
+      # Under microbatching the per-microbatch scalar accuracies were
+      # averaged inside the scan (equal microbatch sizes make that the
+      # effective-batch value); monolithic computes them here.
+      acc = (accum_acc_metrics if accum_acc_metrics is not None
+             else model.accuracy_function(net_result, labels))
       # Scalars only: detection accuracy_functions also return per-box
       # arrays (decoded predictions), which are not replicated step
       # metrics.

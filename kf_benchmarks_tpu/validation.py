@@ -54,6 +54,47 @@ def validate_cross_flags(params) -> None:
     if p.forward_only:
       raise ParamError("--steps_per_dispatch > 1 applies to training "
                        "only; it cannot be combined with --forward_only")
+  if (getattr(p, "num_grad_accum", 1) or 1) > 1:
+    m = p.num_grad_accum
+    # Microbatching wraps the TRAIN step's forward/backward in a scan
+    # (train_step.py); the modes below either have no gradient to
+    # accumulate or consume gradients in a shape the scan cannot feed.
+    if p.eval:
+      raise ParamError("--num_grad_accum > 1 applies to training only; "
+                       "it cannot be combined with --eval")
+    if p.forward_only:
+      raise ParamError("--num_grad_accum > 1 applies to training only; "
+                       "it cannot be combined with --forward_only")
+    if p.batch_size and p.batch_size % m:
+      raise ParamError(
+          f"--num_grad_accum={m} must divide --batch_size="
+          f"{p.batch_size}: the step splits each per-device batch into "
+          "M equal microbatches (a ragged tail microbatch would change "
+          "the gradient weighting silently)")
+    if p.staged_vars:
+      raise ParamError(
+          "--num_grad_accum > 1 cannot be combined with --staged_vars: "
+          "staged reads hand the forward one-step-stale weights from a "
+          "single staging slot per step (variable_mgr.py:246-274); "
+          "microbatches would all read the same stale copy while the "
+          "accumulated update lands once, making the effective "
+          "staleness M-dependent in a way the reference semantics "
+          "never defined")
+    if (p.variable_update == "parameter_server"
+        and not p.cross_replica_sync):
+      raise ParamError(
+          "--num_grad_accum > 1 cannot be combined with async "
+          "parameter_server (--cross_replica_sync=false): the "
+          "sequential-apply path consumes each replica's UNAVERAGED "
+          "per-batch gradient (train_step.py sequential_apply); an "
+          "accumulated mean-of-microbatches gradient would silently "
+          "change what each of its n optimizer applications sees. Use "
+          "a synchronous --variable_update with accumulation")
+    if p.adaptive_batch_size:
+      raise ParamError(
+          "--num_grad_accum > 1 cannot be combined with "
+          "--adaptive_batch_size: the policy re-picks the per-device "
+          "batch mid-run and cannot guarantee divisibility by M")
   if p.num_epochs is not None and p.num_epochs <= 0:
     raise ParamError("--num_epochs must be positive")
   if p.num_eval_batches is not None and p.num_eval_epochs is not None:

@@ -12,6 +12,7 @@ Usage:
     python run_tests.py                          # fast suite
     python run_tests.py --full_tests             # everything non-process
     python run_tests.py --run_distributed_tests  # process-spawning suite
+    python run_tests.py --report-slowest[=N]     # + top-N duration table
 """
 
 import argparse
@@ -39,17 +40,9 @@ SLOW_TESTS = [
 ]
 
 
-def main(argv=None):
-  parser = argparse.ArgumentParser(description=__doc__)
-  parser.add_argument("--full_tests", action="store_true",
-                      help="include the long-running suites")
-  parser.add_argument("--run_distributed_tests", action="store_true",
-                      help="run ONLY the process-spawning suites")
-  args, pytest_args = parser.parse_known_args(argv)
-  if args.full_tests and args.run_distributed_tests:
-    parser.error("--run_distributed_tests selects ONLY the "
-                 "process-spawning suites; run the two invocations "
-                 "separately (the reference gates them the same way)")
+def build_pytest_args(args, pytest_args):
+  """The pytest argv tail the selected tier implies (split out so the
+  tiering/flag logic is unit-testable without spawning pytest)."""
   marker = []
   if args.run_distributed_tests:
     targets = DISTRIBUTED_TESTS
@@ -67,8 +60,42 @@ def main(argv=None):
       # inside otherwise-fast files (e.g. the 2x48-step dispatch
       # benchmark); --full_tests runs everything either way.
       marker = ["-m", "not slow"]
-  cmd = [sys.executable, "-m", "pytest", "-q"] + marker + targets \
-      + pytest_args
+  durations = []
+  if args.report_slowest is not None:
+    # Wall-budget guardrail (the tier-1 suite has an 870 s budget): the
+    # closing table names the tests to mark @pytest.mark.slow next.
+    durations = [f"--durations={args.report_slowest}",
+                 "--durations-min=1.0"]
+  return ["-q"] + marker + durations + targets + pytest_args
+
+
+def main(argv=None):
+  parser = argparse.ArgumentParser(description=__doc__)
+  parser.add_argument("--full_tests", action="store_true",
+                      help="include the long-running suites")
+  parser.add_argument("--run_distributed_tests", action="store_true",
+                      help="run ONLY the process-spawning suites")
+  parser.add_argument("--report-slowest", nargs="?", const="15",
+                      default=None, metavar="N", dest="report_slowest",
+                      help="print the N slowest tests (default 15) after "
+                           "the run -- the budget guardrail for tiering "
+                           "new tests")
+  args, pytest_args = parser.parse_known_args(argv)
+  if args.report_slowest is not None:
+    try:
+      args.report_slowest = int(args.report_slowest)
+    except ValueError:
+      # nargs='?' greedily consumed a passthrough pytest arg
+      # ('--report-slowest tests/test_x.py'): give it back and keep the
+      # default N.
+      pytest_args.insert(0, args.report_slowest)
+      args.report_slowest = 15
+  if args.full_tests and args.run_distributed_tests:
+    parser.error("--run_distributed_tests selects ONLY the "
+                 "process-spawning suites; run the two invocations "
+                 "separately (the reference gates them the same way)")
+  cmd = [sys.executable, "-m", "pytest"] + build_pytest_args(
+      args, pytest_args)
   return subprocess.call(cmd, cwd=REPO)
 
 

@@ -256,3 +256,42 @@ def test_strategy_integration():
                             out_specs=P("replica")))
   np.testing.assert_allclose(np.asarray(f(vals)), np.full((N, 17), 3.5),
                              rtol=1e-6)
+
+
+# -- hier selection warning (VERDICT weak #4) ---------------------------------
+
+def test_hier_warns_on_single_process_mesh():
+  """'hier' is unvalidated at scale and pointless without a host
+  boundary; selecting it single-process logs a one-line warning at
+  build time (both selection sites: the spec planner and
+  --hierarchical_copy)."""
+  from kf_benchmarks_tpu import params as params_lib
+  from kf_benchmarks_tpu.utils import log as log_util
+  logs = []
+  orig = log_util.log_fn
+  log_util.log_fn = logs.append
+  try:
+    allreduce.build_planner(params_lib.make_params(
+        all_reduce_spec="psum:32k:hier", num_devices=4))
+    allreduce.build_reducer(params_lib.make_params(
+        hierarchical_copy=True, num_devices=4, device="cpu"))
+  finally:
+    log_util.log_fn = orig
+  warns = [l for l in logs if "unvalidated at scale" in l]
+  assert len(warns) == 2, logs
+  assert any("--all_reduce_spec=psum:32k:hier" in w for w in warns)
+  assert any("--hierarchical_copy" in w for w in warns)
+
+
+def test_psum_spec_does_not_warn():
+  from kf_benchmarks_tpu import params as params_lib
+  from kf_benchmarks_tpu.utils import log as log_util
+  logs = []
+  orig = log_util.log_fn
+  log_util.log_fn = logs.append
+  try:
+    allreduce.build_planner(params_lib.make_params(
+        all_reduce_spec="psum", num_devices=4))
+  finally:
+    log_util.log_fn = orig
+  assert not [l for l in logs if "unvalidated" in l], logs

@@ -49,10 +49,12 @@ def test_per_op_profile_table(tmp_path, capsys):
   lines = table.splitlines()
   assert lines[0].startswith("Top 20 ops by estimated accelerator time")
   assert lines[1] == observability.PER_OP_TABLE_HEADER
-  # The table closes with the host-axis line the per-op rows cannot
-  # carry: per-dispatch RTT amortization (--steps_per_dispatch).
-  assert lines[-1].startswith("dispatch overhead:")
-  ranked = lines[2:-1]
+  # The table closes with the two whole-program lines the per-op rows
+  # cannot carry: per-dispatch RTT amortization (--steps_per_dispatch)
+  # and the roofline MFU ceiling (round 7).
+  assert lines[-2].startswith("dispatch overhead:")
+  assert lines[-1].startswith("MFU: ")
+  ranked = lines[2:-2]
   assert len(ranked) > 1  # actual ranked rows
   # Ranked by estimated time, descending.
   times = [float(l.split()[1]) for l in ranked]
@@ -276,3 +278,114 @@ def test_eval_metrics_logged(tmp_path):
   names = {m["name"] for m in metrics}
   assert {"eval_top_1_accuracy", "eval_top_5_accuracy",
           "eval_images_per_sec"} <= names
+
+
+# -- MFU + peak-HBM lines (VERDICT stretch #9) --------------------------------
+
+def test_mfu_line_math_and_format():
+  # 98.5 TFLOP/s over the 197 TFLOP/s peak = 50%.
+  line = observability.mfu_line(98.5e12 * 0.004, 0.004)
+  assert line.startswith("MFU: 50.0%"), line
+  assert "98.50 TFLOP/s" in line
+  assert "197 TFLOP/s" in line
+  assert observability.mfu_line(1.0, 0.0) == "MFU: n/a (no step time)"
+  # Measured-rate variant names its source for auditability.
+  assert "measured" in observability.mfu_line(1e12, 1.0,
+                                              source="measured")
+
+
+def test_per_op_table_ends_with_mfu_line():
+  hlo = """
+HloModule m
+ENTRY e {
+  %p0 = f32[64,64] parameter(0)
+  %p1 = f32[64,64] parameter(1)
+  ROOT %d = f32[64,64] dot(%p0, %p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+"""
+  table = observability.per_op_table(hlo)
+  lines = table.splitlines()
+  assert lines[-1].startswith("MFU: ")
+  assert lines[-2].startswith("dispatch overhead:")
+  # flops of the dot appear in the MFU line's flops/step field.
+  assert "5.243e+05" in lines[-1], lines[-1]
+
+
+def test_hbm_breakdown_line():
+  class Mem:
+    argument_size_in_bytes = 3 * 1024 * 1024
+    output_size_in_bytes = 1024 * 1024
+    temp_size_in_bytes = 5 * 1024 * 1024
+  line = observability.hbm_breakdown_line(Mem())
+  assert "peak HBM (compiled): 8.0 MiB" in line
+  assert "arguments 3.0" in line and "temps 5.0" in line
+
+
+def test_tfprof_run_logs_hbm_line(tmp_path):
+  """--tfprof_file runs log the peak-HBM breakdown next to the per-op
+  table (the footprint line the round-7 HBM levers move)."""
+  from kf_benchmarks_tpu.utils import log as log_util
+  logs = []
+  orig = log_util.log_fn
+  log_util.log_fn = logs.append
+  try:
+    p = params_lib.make_params(
+        model="trivial", device="cpu", batch_size=2, num_devices=2,
+        num_batches=2, num_warmup_batches=0,
+        tfprof_file=str(tmp_path / "prof.json"))
+    benchmark.BenchmarkCNN(p).run()
+  finally:
+    log_util.log_fn = orig
+  hbm = [l for l in logs if l.startswith("peak HBM (compiled):")]
+  assert len(hbm) == 1, [l for l in logs if "HBM" in l]
+  mfu = [l for l in logs if l.startswith("MFU: ")]
+  assert mfu, "per-op table should close with the MFU line"
+
+
+# -- run_tests.py tiering helpers ---------------------------------------------
+
+def test_run_tests_report_slowest_flag():
+  import argparse
+  import importlib.util
+  spec = importlib.util.spec_from_file_location(
+      "run_tests", os.path.join(os.path.dirname(__file__), "..",
+                                "run_tests.py"))
+  rt = importlib.util.module_from_spec(spec)
+  spec.loader.exec_module(rt)
+  ns = argparse.Namespace(full_tests=False, run_distributed_tests=False,
+                          report_slowest=15)
+  args = rt.build_pytest_args(ns, [])
+  assert "--durations=15" in args and "--durations-min=1.0" in args
+  assert ["-m", "not slow"] == [a for a in args if a in ("-m", "not slow")]
+  ns.report_slowest = None
+  assert not any(a.startswith("--durations") for a in
+                 rt.build_pytest_args(ns, []))
+  # The new memory-regression suites ride the fast tier (they are
+  # compile-only seconds, not minutes); the heavy e2e stays tiered out.
+  fast_targets = [a for a in args if a.startswith("tests/")]
+  assert "tests/test_fused_loss.py" in fast_targets
+  assert "tests/test_transformer_lm_e2e.py" not in fast_targets
+
+
+def test_run_tests_report_slowest_reclaims_swallowed_target(monkeypatch):
+  """nargs='?' would otherwise eat a passthrough pytest target as N;
+  main() gives it back and keeps the default (review-caught)."""
+  import importlib.util
+  spec = importlib.util.spec_from_file_location(
+      "run_tests2", os.path.join(os.path.dirname(__file__), "..",
+                                 "run_tests.py"))
+  rt = importlib.util.module_from_spec(spec)
+  spec.loader.exec_module(rt)
+  captured = {}
+
+  def fake_call(cmd, cwd=None):
+    captured["cmd"] = cmd
+    return 0
+
+  monkeypatch.setattr(rt.subprocess, "call", fake_call)
+  assert rt.main(["--report-slowest", "tests/test_observability.py"]) == 0
+  cmd = captured["cmd"]
+  assert "--durations=15" in cmd
+  assert "tests/test_observability.py" in cmd
+  assert rt.main(["--report-slowest=5"]) == 0
+  assert "--durations=5" in captured["cmd"]

@@ -30,15 +30,20 @@ def test_module_shapes_and_loss():
   tokens = jax.random.randint(jax.random.PRNGKey(0), (2, t), 0, vocab)
   labels = jnp.roll(tokens, -1, axis=1)
   variables = module.init({"params": jax.random.PRNGKey(1)}, tokens)
-  logits, aux = module.apply(variables, tokens)
+  out, aux = module.apply(variables, tokens)
   assert aux is None
-  assert logits.shape == (2, t, vocab)
-  # Head computes in model dtype (f32 logits were the measured HBM
-  # peak); the loss upcasts per chunk.
-  assert logits.dtype == jnp.bfloat16
+  # The default head is FUSED: no (B, T, V) logits tensor exists; the
+  # module hands (hidden, kernel) to the chunked loss (ops/fused_loss).
+  from kf_benchmarks_tpu.ops import fused_loss
+  assert isinstance(out, fused_loss.FusedLMHead)
+  assert out.hidden.shape == (2, t, 32)
+  # Hidden states ride the model dtype (f32 logits were the measured
+  # HBM peak); the loss upcasts per chunk.
+  assert out.hidden.dtype == jnp.bfloat16
+  assert out.kernel.shape == (32, vocab)
   from kf_benchmarks_tpu.models.model import BuildNetworkResult
   model = model_config.get_model_config("transformer_lm", "synthetic")
-  result = BuildNetworkResult(logits=(logits, aux))
+  result = BuildNetworkResult(logits=(out, aux))
   loss = model.loss_function(result, labels)
   # Untrained uniform-ish logits: CE near ln(vocab).
   assert np.isfinite(float(loss))
@@ -61,7 +66,8 @@ def test_flash_branch_traces_on_cpu():
       lambda: module.init({"params": jax.random.PRNGKey(0)}, tokens))
   out = jax.eval_shape(
       lambda v: module.apply(v, tokens)[0], variables)
-  assert out.shape == (1, t, vocab)
+  assert out.hidden.shape == (1, t, 512)
+  assert out.kernel.shape == (512, vocab)
 
 
 def test_make_module_rejects_unknown_attn_impl(monkeypatch):
