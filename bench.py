@@ -84,11 +84,21 @@ def main():
   # record can't be mistaken for a TPU regression.
   metric = ("resnet50_synthetic_images_per_sec" if on_tpu
             else "resnet50_synthetic_images_per_sec_CPU_FALLBACK_tpu_unreachable")
+  # compile_s: wall time of the first dispatch (blocks on trace +
+  # compile); dispatch_overhead_s: mean host time per timed dispatch
+  # call (jit-call + tunnel RTT -- what --steps_per_dispatch
+  # amortizes). Together they let the BENCH_* trajectory track compile
+  # latency and RTT amortization, not just img/s.
+  compile_s = stats.get("compile_s")
+  dispatch_s = stats.get("dispatch_overhead_s")
   print(json.dumps({
       "metric": metric,
       "value": round(value, 2),
       "unit": "images/sec",
       "vs_baseline": round(value / BASELINE_IMAGES_PER_SEC, 3),
+      "compile_s": round(compile_s, 3) if compile_s is not None else None,
+      "dispatch_overhead_s": (round(dispatch_s, 6)
+                              if dispatch_s is not None else None),
   }), flush=True)
 
 

@@ -15,10 +15,18 @@ comes from ops/allreduce.py, sharing the reference's spec grammar.
 
 Run: python -m kf_benchmarks_tpu.all_reduce_benchmark --model=resnet50 \
          --num_batches=10 --all_reduce_spec=psum
+
+``--sweep`` replaces the single-config run with the PERF.md round-5
+n x spec x size step-time table from ONE command (previously a hand-run
+procedure): every (device count, algorithm, packed-vector size) cell is
+timed the same way -- chained iterations inside one compiled program,
+drain()-bounded windows -- and the result prints as a markdown table
+plus one JSON line.
 """
 
 from __future__ import annotations
 
+import json
 import time
 from typing import Dict, List, Sequence, Tuple
 
@@ -41,6 +49,21 @@ if "iters_per_step" not in flags.param_specs:
       "iters_per_step", 5,
       "Number of chained all-reduce iterations inside one compiled step "
       "(ref: all_reduce_benchmark.py flag of the same name).")
+if "sweep" not in flags.param_specs:
+  flags.DEFINE_boolean(
+      "sweep", False,
+      "Emit the PERF round-5 n x spec x size step-time table (markdown "
+      "+ one JSON line) instead of the single-config model-shaped run: "
+      "device counts are powers of two up to --num_devices, algorithms "
+      "from --sweep_specs, packed-vector sizes from --sweep_sizes.")
+  flags.DEFINE_string(
+      "sweep_specs", "psum,rsag,hier",
+      "Comma-separated algorithms for --sweep (spec grammar "
+      "alg[#shards]; reference aliases accepted).")
+  flags.DEFINE_string(
+      "sweep_sizes", "256k,4m",
+      "Comma-separated packed-vector byte sizes for --sweep "
+      "(spec-grammar limits: <int>[kKmM]).")
 
 
 def get_var_shapes(model, nclass: int = 1001) -> List[Tuple[int, ...]]:
@@ -146,6 +169,120 @@ def run_benchmark(params) -> Dict[str, float]:
   }
 
 
+def sweep_device_counts(total: int) -> List[int]:
+  """Powers of two up to the available device count (the round-5 table's
+  n axis; a non-power-of-two total contributes itself as the last row)."""
+  ns, n = [], 2
+  while n <= total:
+    ns.append(n)
+    n *= 2
+  if not ns or ns[-1] != total:
+    ns.append(total)
+  return [n for n in ns if n <= total]
+
+
+def build_vector_step(mesh, spec_tuple, iters_per_step: int):
+  """One compiled step: ``iters_per_step`` chained reductions of a
+  single packed vector (the gradient-vector shape every packed path
+  reduces), chained by data dependency like build_all_reduce_step."""
+
+  def body(vec):
+    vec = vec[0]  # (1, elems) local shard -> the flat packed vector
+    for i in range(iters_per_step):
+      vec = allreduce._reduce_packed(vec, spec_tuple, REPLICA_AXIS)
+      if i + 1 < iters_per_step:
+        vec = vec + jnp.asarray(1e-6, vec.dtype)
+    return vec[None]
+
+  fn = jax.shard_map(body, mesh=mesh, in_specs=P(REPLICA_AXIS),
+                     out_specs=P(REPLICA_AXIS))
+  return jax.jit(fn)
+
+
+def run_sweep(params) -> List[Dict[str, float]]:
+  """The round-5 n x spec x size table from one command (PERF.md
+  "All-reduce on a 4 MiB gradient vector" was hand-run per cell).
+
+  Per-all-reduce time is measured DIFFERENTIALLY: each cell times two
+  compiled programs chaining k and 2k reductions and differences them,
+  so per-dispatch host cost cancels -- on the tunneled chip a single
+  dispatch pays ~70 ms RTT, which would otherwise swamp every
+  microsecond-scale cell (CLAUDE.md measurement rule; PERF.md round-5
+  measurement correction). step_ms stays the raw k-iteration dispatch
+  wall for context.
+
+  Markdown rows via the logger; ONE JSON line on stdout so a harness
+  can scrape the whole table like bench.py's result line."""
+  devices = mesh_lib.get_devices(params.device, params.num_devices or None)
+  iters = getattr(params, "iters_per_step", 5)
+  num_steps = params.num_batches or 10
+  warmup = params.num_warmup_batches
+  warmup = 2 if warmup is None else max(warmup, 1)
+  sizes = [allreduce._parse_limit(s.strip())
+           for s in params.sweep_sizes.split(",") if s.strip()]
+  spec_names = [s.strip() for s in params.sweep_specs.split(",")
+                if s.strip()]
+  dtype = jnp.bfloat16 if params.use_fp16 else jnp.float32
+  itemsize = jnp.dtype(dtype).itemsize
+  rows = []
+  log_util.log_fn(f"All-reduce sweep: n x spec x size over "
+                  f"{len(devices)} available devices, {iters} "
+                  f"iters/step, {num_steps} timed steps")
+  log_util.log_fn("| n | spec | size | step ms | per-all-reduce ms |")
+  log_util.log_fn("|---|---|---|---|---|")
+  rng = np.random.RandomState(0)
+
+  def timed(step, vec):
+    for _ in range(warmup):  # includes compile
+      out = step(vec)
+    sync.drain(out)
+    start = time.monotonic()
+    for _ in range(num_steps):
+      out = step(out)
+    sync.drain(out)
+    return (time.monotonic() - start) / num_steps
+
+  for n in sweep_device_counts(len(devices)):
+    mesh = mesh_lib.build_mesh(devices=devices[:n])
+    for spec_name in spec_names:
+      tup = allreduce._parse_alg(spec_name)
+      if tup.alg == "hier":
+        tup = tup._replace(shards=max(tup.shards, 2))
+      step_k = build_vector_step(mesh, tup, iters)
+      step_2k = build_vector_step(mesh, tup, 2 * iters)
+      for size in sizes:
+        elems = max(size // itemsize, n)
+        sharding = NamedSharding(mesh, P(REPLICA_AXIS))
+        vec = jax.device_put(
+            rng.normal(size=(n, elems)).astype(dtype), sharding)
+        step_s = timed(step_k, vec)
+        step2_s = timed(step_2k, vec)
+        # Differencing the k- and 2k-iteration programs cancels the
+        # per-dispatch host/tunnel cost; clamp at 0 (pure noise floor
+        # on cells faster than the timer jitter).
+        per_reduce_s = max(step2_s - step_s, 0.0) / iters
+        rows.append({"n": n, "spec": spec_name, "bytes": int(size),
+                     "step_ms": round(step_s * 1e3, 3),
+                     "all_reduce_ms": round(per_reduce_s * 1e3, 3)})
+        log_util.log_fn(
+            "| %d | %s | %s | %.3f | %.3f |" % (
+                n, spec_name, _fmt_bytes(size), step_s * 1e3,
+                per_reduce_s * 1e3))
+  print(json.dumps({"metric": "all_reduce_sweep",
+                    "iters_per_step": iters, "num_steps": num_steps,
+                    "dtype": jnp.dtype(dtype).name, "rows": rows}),
+        flush=True)
+  return rows
+
+
+def _fmt_bytes(size: int) -> str:
+  if size % (1024 * 1024) == 0:
+    return f"{size // (1024 * 1024)}m"
+  if size % 1024 == 0:
+    return f"{size // 1024}k"
+  return str(size)
+
+
 def main(positional_arguments):
   from absl import app
   from kf_benchmarks_tpu import params as params_lib
@@ -155,7 +292,10 @@ def main(positional_arguments):
   from kf_benchmarks_tpu import benchmark
   params = params_lib.make_params_from_flags()
   params = benchmark.setup(params)
-  run_benchmark(params)
+  if getattr(params, "sweep", False):
+    run_sweep(params)
+  else:
+    run_benchmark(params)
 
 
 def run_main():

@@ -135,6 +135,17 @@ class _TransformerLMModule(nn.Module):
   # materialize logits (the monolithic head the oracle tests pin
   # against).
   fused_head: bool = True
+  # Mesh axis for in-backward gradient reduction of the scanned layer
+  # stack (--overlap_gradient_reduction, ops/overlap.py): each scan
+  # backward iteration then reduces THAT layer's gradient slice inside
+  # the loop body, overlapped with the next iteration's backward
+  # compute. None = no hooks (the post-hoc reduction path). Only
+  # meaningful with scan_layers; requires apply() to run inside a
+  # shard_map body where the axis is bound.
+  grad_reduce_axis: Any = None
+  # Optional 16-bit wire dtype for the hook's collectives
+  # (allreduce.compact_wire_dtype); None = the gradient's own dtype.
+  grad_reduce_compact: Any = None
   max_len: int = SEQ_LEN
   dtype: Any = jnp.float32
   param_dtype: Any = jnp.float32
@@ -163,8 +174,24 @@ class _TransformerLMModule(nn.Module):
       # boundaries as backward residuals. prevent_cse=False is the
       # scan-safe setting (the scan barrier already blocks the CSE
       # that prevent_cse guards against; True pessimizes TPU code).
+      block_cls = _Block
+      if self.grad_reduce_axis is not None:
+        # In-backward reduction hook (ops/overlap.py): the block's
+        # per-layer param slice passes through an identity-with-
+        # custom_vjp whose backward pmeans the slice's cotangent, so
+        # the collective lands INSIDE the backward scan's loop body
+        # (pinned at the HLO level by tests/test_overlap_reduction.py).
+        # The forward transform is the identity, so init (init=True)
+        # and eval apply are unaffected.
+        from kf_benchmarks_tpu.ops import overlap as overlap_lib
+        block_cls = nn.map_variables(
+            _Block, "params",
+            trans_in_fn=overlap_lib.scan_block_hook(
+                self.grad_reduce_axis,
+                compact_dtype=self.grad_reduce_compact),
+            init=True)
       blocks = nn.scan(
-          nn.remat(_Block, prevent_cse=False),
+          nn.remat(block_cls, prevent_cse=False),
           variable_axes={"params": 0},
           split_rngs={"params": True},
           length=self.n_layers)(name="blocks", **block_kwargs)
@@ -199,7 +226,7 @@ class TransformerLMModel(model_lib.Model):
 
   def make_module(self, nclass, phase_train, data_format="NHWC",
                   dtype=jnp.float32, param_dtype=jnp.float32):
-    del nclass, phase_train, data_format
+    del nclass, data_format
     import os
     impl = os.environ.get("KF_TRANSFORMER_LM_ATTN", "tiled")
     if impl not in ("tiled", "flash"):
@@ -216,10 +243,30 @@ class TransformerLMModel(model_lib.Model):
       raise ValueError(
           f"KF_TRANSFORMER_LM_LAYERS must be 'scan' or 'loop', got "
           f"{layers!r}")
+    # --overlap_gradient_reduction: hook the scanned layer stack so
+    # each backward scan iteration reduces its OWN layer's gradient
+    # slice inside the loop body (ops/overlap.py scan_block_hook). The
+    # training module only (eval has no backward); disengaged under
+    # --num_grad_accum, where reduction stays post-hoc on the
+    # accumulated tree (train_step.py). in_backward_reduced_prefixes
+    # tells the step-level bucket planner these leaves are covered.
+    grad_reduce_axis = None
+    grad_reduce_compact = None
+    p = self.params
+    if (phase_train and layers == "scan" and p is not None
+        and getattr(p, "overlap_gradient_reduction", False)
+        and (getattr(p, "num_grad_accum", 1) or 1) == 1):
+      from kf_benchmarks_tpu.ops import allreduce
+      from kf_benchmarks_tpu.parallel.mesh import REPLICA_AXIS
+      grad_reduce_axis = REPLICA_AXIS
+      grad_reduce_compact = allreduce.compact_wire_dtype(p)
+      self.in_backward_reduced_prefixes = ("blocks",)
     return _TransformerLMModule(dtype=dtype, param_dtype=param_dtype,
                                 attn_impl=impl,
                                 fused_head=head == "fused",
-                                scan_layers=layers == "scan")
+                                scan_layers=layers == "scan",
+                                grad_reduce_axis=grad_reduce_axis,
+                                grad_reduce_compact=grad_reduce_compact)
 
   def get_input_shapes(self, subset):
     n = self.get_batch_size()

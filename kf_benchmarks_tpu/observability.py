@@ -281,6 +281,75 @@ def per_op_costs(hlo_text: str):
   return rows
 
 
+# Collective opcodes (the communication side of the comm/compute
+# overlap accounting; -start/-done async forms match by prefix).
+_COLLECTIVE_OPCODES = ("all-reduce", "reduce-scatter", "all-gather",
+                       "collective-permute", "all-to-all")
+
+
+def collective_overlap_stats(hlo_text: str):
+  """Static comm/compute overlap accounting from an optimized-HLO dump.
+
+  A collective that lives INSIDE a loop body (a computation referenced
+  by a while instruction's ``body=``) was issued in-backward -- e.g.
+  per scanned block under --overlap_gradient_reduction -- and the
+  scheduler can interleave it with the remaining loop iterations'
+  compute; a top-level collective serializes after the compute feeding
+  it. Returns {num_collectives, comm_s, comm_in_loop_s,
+  overlap_fraction} with times from the same bandwidth roofline as the
+  per-op table (the RANKING convention; absolute seconds are
+  chip-relative).
+  """
+  body_names = set(re.findall(r"body=%?([\w\.\-]+)", hlo_text))
+  comp = None
+  num = 0
+  comm_s = 0.0
+  in_loop_s = 0.0
+  for line in hlo_text.splitlines():
+    s = line.strip()
+    if s.endswith("{") and "(" in s:
+      toks = s.split()
+      if toks:
+        name = toks[1] if toks[0] == "ENTRY" and len(toks) > 1 else toks[0]
+        comp = name.lstrip("%")
+      continue
+    m = _INSTR_RE.match(line)
+    if not m:
+      continue
+    opcode = m.group(3)
+    base = opcode[:-6] if opcode.endswith("-start") else opcode
+    if base not in _COLLECTIVE_OPCODES:
+      continue
+    num += 1
+    est = _shapes_bytes(m.group(2)) / TPU_PEAK_BYTES_PER_S
+    comm_s += est
+    if comp in body_names:
+      in_loop_s += est
+  return {
+      "num_collectives": num,
+      "comm_s": comm_s,
+      "comm_in_loop_s": in_loop_s,
+      "overlap_fraction": in_loop_s / comm_s if comm_s else 0.0,
+  }
+
+
+def overlap_fraction_line(hlo_text: str) -> str:
+  """One roofline-table line for the comm/compute overlap axis: how
+  much of the program's collective time is issued inside loop bodies
+  (in-backward, schedulable against remaining compute -- what
+  --overlap_gradient_reduction moves) vs trailing the compute."""
+  stats = collective_overlap_stats(hlo_text)
+  if not stats["num_collectives"]:
+    return ("comm/compute overlap: no collectives in program "
+            "(single replica or unreduced mode)")
+  return (f"comm/compute overlap: {stats['num_collectives']} "
+          f"collectives, ~{stats['comm_s'] * 1e6:.1f} us est comm; "
+          f"{100.0 * stats['overlap_fraction']:.1f}% issued inside "
+          "loop bodies (in-backward, overlappable with compute), "
+          f"{(stats['comm_s'] - stats['comm_in_loop_s']) * 1e6:.1f} us "
+          "serialized after it")
+
+
 PER_OP_TABLE_HEADER = ("rank  est_time_us  %total        flops"
                        "        bytes  op")
 
@@ -364,6 +433,7 @@ def per_op_table(hlo_text: str, top_n: int = 20,
         f"{r['bytes']:11.3e}  {r['name']} {r['opcode']}")
   lines.append(dispatch_overhead_line(total, steps_per_dispatch))
   lines.append(mfu_line(total_flops, total))
+  lines.append(overlap_fraction_line(hlo_text))
   return "\n".join(lines)
 
 

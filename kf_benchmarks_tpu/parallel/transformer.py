@@ -164,6 +164,42 @@ def stacked_param_specs():
   return {"embed": P(), "pos": P(), "ln_f": P(), "blocks": blocks}
 
 
+def _scan_grad_hook(data_axes):
+  """In-backward data-axis gradient reduction for the scanned layer
+  stack (--overlap_gradient_reduction's composed-trainer analog): the
+  returned hook wraps one layer's param slice at the top of the scan
+  body so that layer's data-parallel gradient reduction is issued
+  INSIDE the backward scan iteration -- overlapped with the next
+  iteration's backward compute -- instead of trailing the whole
+  backward.
+
+  Two implementations, gated on the vma API (``lax.pcast`` is the
+  missing API pre-vma, the same gate as compat.py/sequence.vary_like):
+
+  * vma jax: pcast the slice to varying on the data axes. Downstream
+    ops then need no implicit pbroadcast, and pcast's TRANSPOSE is the
+    psum -- placed exactly here, in the scan body. Total reduction
+    semantics are unchanged (the implicit machinery inserted the same
+    psum); only its schedule position moves.
+  * pre-vma jax: an identity-with-custom_vjp whose backward psums the
+    slice cotangent over the data axes explicitly (pre-vma shard_map
+    autodiff inserts no implicit psums).
+  """
+  if hasattr(lax, "pcast"):
+    def hook(lp):
+      return jax.tree.map(
+          lambda t: lax.pcast(t, data_axes, to="varying"), lp)
+    return hook
+  from kf_benchmarks_tpu.ops import overlap as overlap_lib
+  reduce_fn = lambda g: jax.tree.map(
+      lambda t: lax.psum(t, data_axes), g)
+
+  def hook(lp):
+    return overlap_lib.reduce_identity(reduce_fn, lp)
+
+  return hook
+
+
 def _rmsnorm(x, scale, eps=1e-6):
   x = x.astype(jnp.float32)
   return (x * jax.lax.rsqrt(jnp.mean(x * x, -1, keepdims=True) + eps)
@@ -232,7 +268,8 @@ def _attention_residual(lp, x, *, seq_axis, tensor_axis, sp_layout,
 def forward_local(params, tokens, *, seq_axis=SEQ_AXIS,
                   tensor_axis=TENSOR_AXIS, expert_axis=REPLICA_AXIS,
                   moe_capacity=None, sp_layout: str = "contiguous",
-                  attn_inner_block=None, remat_policy=None):
+                  attn_inner_block=None, remat_policy=None,
+                  grad_reduce_axes=None):
   """Per-shard forward: tokens (B_local, T_local) -> (logits, moe_aux).
 
   Runs inside a shard_map body; params are the LOCAL shards
@@ -253,6 +290,9 @@ def forward_local(params, tokens, *, seq_axis=SEQ_AXIS,
   (None = save nothing, recompute the whole block;
   e.g. jax.checkpoint_policies.dots_with_no_batch_dims_saveable keeps
   the matmul outputs and recomputes only the cheap elementwise work).
+  ``grad_reduce_axes`` (scanned path only) hooks each layer's param
+  slice with :func:`_scan_grad_hook` so the layer's data-axis gradient
+  reduction runs inside the backward scan iteration.
   """
   b, t = tokens.shape
   x = _embed_positions(params, tokens, seq_axis=seq_axis,
@@ -260,7 +300,12 @@ def forward_local(params, tokens, *, seq_axis=SEQ_AXIS,
   moe_aux = jnp.zeros((), jnp.float32)
   if not isinstance(params["blocks"], (list, tuple)):
     # Scanned stack (homogeneous by stack_blocks construction).
+    block_hook = (_scan_grad_hook(grad_reduce_axes)
+                  if grad_reduce_axes else None)
+
     def one_block(xm, lp):
+      if block_hook is not None:
+        lp = block_hook(lp)
       xm, h = _attention_residual(lp, xm, seq_axis=seq_axis,
                                   tensor_axis=tensor_axis,
                                   sp_layout=sp_layout,
@@ -417,7 +462,8 @@ def make_train_step(mesh: Mesh, params_template, learning_rate: float,
                     moe_capacity=None, moe_aux_weight: float = 0.01,
                     sp_layout: str = "contiguous",
                     attn_inner_block=None, scan_layers: bool = False,
-                    remat_policy=None):
+                    remat_policy=None,
+                    overlap_grad_reduce: bool = False):
   """Jitted SGD train step over GLOBAL (params, tokens, labels):
   tokens/labels (batch, seq) in NORMAL order, sharded (replica, seq);
   params per param_specs. MoE blocks (if any in the template) add
@@ -433,9 +479,24 @@ def make_train_step(mesh: Mesh, params_template, learning_rate: float,
   layer stack as one scanned+rematerialized body (forward_local);
   ``remat_policy`` is its explicit jax.checkpoint policy. Losses and
   trained parameters stay numerically equivalent to the unscanned
-  step (tests/test_transformer_parallel.py pins it)."""
+  step (tests/test_transformer_parallel.py pins it).
+
+  overlap_grad_reduce=True (scanned path only) hooks each layer's
+  param slice in the scan body (_scan_grad_hook) so the layer's
+  data-axis gradient reduction is issued inside the backward scan
+  iteration, overlapped with the preceding layer's backward, instead
+  of trailing the whole backward. Reduction semantics are unchanged on
+  vma jax (the hook only moves the psum's schedule position); on
+  pre-vma jax (no lax.pcast) the hook's explicit psums cover the
+  hooked block leaves only -- the same limitation that gates the
+  composed-trainer oracle tests there."""
   if sp_layout not in ("contiguous", "zigzag"):
     raise ValueError(f"unknown sp_layout {sp_layout!r}")
+  if overlap_grad_reduce and not scan_layers:
+    raise ValueError(
+        "overlap_grad_reduce=True requires scan_layers=True: the hooks "
+        "live in the scanned block body (an unscanned stack already "
+        "exposes every layer's reduction to the scheduler separately)")
   if scan_layers:
     if isinstance(params_template["blocks"], (list, tuple)):
       raise ValueError(
@@ -453,7 +514,9 @@ def make_train_step(mesh: Mesh, params_template, learning_rate: float,
       logits, moe_aux = forward_local(
           p, tokens, moe_capacity=moe_capacity, sp_layout=sp_layout,
           attn_inner_block=attn_inner_block,
-          remat_policy=remat_policy)
+          remat_policy=remat_policy,
+          grad_reduce_axes=((REPLICA_AXIS, SEQ_AXIS)
+                            if overlap_grad_reduce else None))
       return (_loss_from_logits(logits, labels)
               + moe_aux_weight * moe_aux)
 

@@ -155,6 +155,43 @@ flags.DEFINE_integer("gradient_repacking", 0,
 flags.DEFINE_boolean("compact_gradient_transfer", True,
                      "Compact gradients to a 16-bit wire format (bf16) for "
                      "the all-reduce when --use_fp16 is on (ref :503-506).")
+flags.DEFINE_boolean("compact_gradient_transfer_f32", False,
+                     "Engage the 16-bit (bf16) all-reduce wire format for "
+                     "f32 training too -- the reference compacted only "
+                     "fp16 gradients (ref: batch_allreduce.py:96-103); "
+                     "this is the explicit f32 opt-in (halves reduction "
+                     "bytes; a precision note is logged -- NOT "
+                     "bit-identical to the f32 wire). Requires "
+                     "--compact_gradient_transfer AND a reduction path "
+                     "that repacks the wire (--overlap_gradient_reduction "
+                     "or a packed reducer flag); the default per-leaf "
+                     "pmean has nothing to compact (validation.py).")
+flags.DEFINE_boolean("overlap_gradient_reduction", False,
+                     "Overlap gradient communication with backward "
+                     "compute: size-bounded gradient buckets "
+                     "(--reduce_bucket_mb) each reduce as one collective "
+                     "issued IN the backward pass (identity-with-"
+                     "custom_vjp hooks at layer boundaries; per scanned "
+                     "block for scan-over-layers models), so layer L's "
+                     "all-reduce runs while layer L-1's backward is still "
+                     "computing -- the pipelining the reference's chunked "
+                     "batch_allreduce/--gradient_repacking existed for "
+                     "(ref: batch_allreduce.py:391-481). f32 wire "
+                     "gradients stay bit-identical to the post-hoc path "
+                     "(ops/overlap.py). Replicated-family "
+                     "--variable_update only; under --num_grad_accum the "
+                     "reduction stays post-hoc on the accumulated tree "
+                     "(one collective per step); exclusive with the "
+                     "spec/repacking/small-grad/hierarchical reducers "
+                     "(validation.py).")
+flags.DEFINE_integer("reduce_bucket_mb", None,
+                     "Gradient-reduction bucket bound in MiB for "
+                     "--overlap_gradient_reduction (default 4): leaves "
+                     "group at builder-layer granularity and merge into "
+                     "buckets of at most this size, one collective per "
+                     "bucket (ops/overlap.py; the granularity lever the "
+                     "reference's --gradient_repacking chunk count "
+                     "turned, ref :499-502).", lower_bound=1)
 flags.DEFINE_boolean("hierarchical_copy", False,
                      "Two-level reduction: grouped psum within contiguous "
                      "device groups, then across them (ref :507-513).")

@@ -36,6 +36,7 @@ import flax
 import optax
 
 from kf_benchmarks_tpu import elastic as elastic_lib
+from kf_benchmarks_tpu.ops import overlap as overlap_lib
 from kf_benchmarks_tpu.parallel.mesh import REPLICA_AXIS
 
 
@@ -170,6 +171,27 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
   # et al. 2019): backward residuals are sized to B/M instead of B.
   # M=1 keeps the exact monolithic program (the PERF.md envelope).
   num_grad_accum = int(getattr(params, "num_grad_accum", None) or 1)
+  # --overlap_gradient_reduction: bucketed in-backward all-reduce
+  # (ops/overlap.py). Under microbatching the hooks disengage --
+  # reduction stays post-hoc on the ACCUMULATED tree, preserving the
+  # one-collective-per-step invariant (in-backward hooks inside the
+  # microbatch scan would reduce M times per step).
+  overlap_spec = overlap_lib.build(params)
+  overlap_in_step = overlap_spec is not None and num_grad_accum == 1
+  if overlap_spec is not None and num_grad_accum > 1:
+    from kf_benchmarks_tpu.utils import log as log_util
+    log_util.log_fn(
+        f"overlap_gradient_reduction: --num_grad_accum="
+        f"{num_grad_accum} keeps reduction post-hoc on the accumulated "
+        "tree (one collective per step is the pinned invariant); "
+        "in-backward hooks disengaged")
+  # Top-level param-tree keys whose gradients the MODULE already
+  # reduces in-backward (e.g. transformer_lm's scanned 'blocks' stack
+  # hooks per layer inside the nn.scan); the step-level buckets skip
+  # them so each gradient is reduced exactly once.
+  module_reduced_prefixes = tuple(
+      getattr(model, "in_backward_reduced_prefixes", ()) or ()
+  ) if overlap_in_step else ()
   # Modules with a training-progress schedule (NASNet drop-path's
   # global-step ramp, ref: nasnet_utils.py:407-439) take ``progress`` =
   # step / total_training_steps; total steps is the run's --num_batches.
@@ -241,6 +263,20 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
           state.step.astype(jnp.float32) / total_train_steps)
 
     def loss_fn(p, mb_images, mb_labels, bs, dropout_rng):
+      if overlap_in_step:
+        # Bucketed in-backward reduction (ops/overlap.py): every use of
+        # p below flows through the wrapped copy, so jax.grad returns
+        # ALREADY replica-reduced gradients, one collective per bucket
+        # issued where that bucket's backward completes. The post-hoc
+        # strategy reduction is skipped (overlap_in_step below).
+        # Ordering vs the loss-scale unscale is exact: the hooks reduce
+        # the SCALED cotangents and the unscale divides by a
+        # power-of-two scale afterwards (exponent shift; bit-identical
+        # to dividing first, as the post-hoc path does).
+        p = overlap_lib.wrap_tree(
+            p, REPLICA_AXIS, overlap_spec.bucket_bytes,
+            compact_dtype=overlap_spec.compact_dtype,
+            exclude_prefixes=module_reduced_prefixes)
       variables = {"params": p}
       if bs:
         variables["batch_stats"] = bs
@@ -349,7 +385,13 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
       # scale").
       noise_stats = elastic_lib.noise_scale_stats(
           grads, REPLICA_AXIS, images.shape[0])
-    grads = strategy.reduce_gradients(grads, REPLICA_AXIS)
+    if not overlap_in_step:
+      grads = strategy.reduce_gradients(grads, REPLICA_AXIS)
+    # else: the in-backward hooks already reduced every bucket
+    # (module-internal hooks for module_reduced_prefixes, the loss_fn
+    # wrap for the rest); everything downstream -- the auto-loss-scale
+    # finite check, relaxed-consistency banking, the optimizer apply --
+    # sees the reduced tree exactly as on the post-hoc path.
 
     def _all_finite(tree):
       ok = jnp.all(jnp.stack(

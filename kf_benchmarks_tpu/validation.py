@@ -291,6 +291,75 @@ def validate_cross_flags(params) -> None:
         "--hierarchical_copy cannot be combined with --all_reduce_spec "
         "(use the 'hier' algorithm inside the spec instead; "
         "ref :507-513 vs :532-553)")
+  if getattr(p, "compact_gradient_transfer_f32", False):
+    if not p.compact_gradient_transfer:
+      raise ParamError(
+          "--compact_gradient_transfer_f32 requires "
+          "--compact_gradient_transfer: it widens WHEN the 16-bit wire "
+          "format engages (f32 training too), it cannot engage a "
+          "compaction that is switched off")
+    if not (p.use_fp16 or p.all_reduce_spec or p.gradient_repacking
+            or p.agg_small_grads_max_bytes > 0 or p.hierarchical_copy
+            or getattr(p, "overlap_gradient_reduction", False)):
+      raise ParamError(
+          "--compact_gradient_transfer_f32 has no effect without a "
+          "reduction path that repacks the wire: the default per-leaf "
+          "pmean never re-encodes gradients (ops/allreduce.py "
+          "build_reducer returns None). Select a packed path -- "
+          "--overlap_gradient_reduction, --all_reduce_spec, "
+          "--gradient_repacking, --agg_small_grads_max_bytes or "
+          "--hierarchical_copy -- or drop the flag (a silent no-op "
+          "that logs a halved-bytes note would misrecord the run)")
+  if getattr(p, "reduce_bucket_mb", None) and \
+      not getattr(p, "overlap_gradient_reduction", False):
+    raise ParamError(
+        "--reduce_bucket_mb sizes the in-backward reduction buckets and "
+        "requires --overlap_gradient_reduction (the post-hoc paths' "
+        "granularity levers are --gradient_repacking / "
+        "--agg_small_grads_max_bytes / --all_reduce_spec)")
+  if getattr(p, "overlap_gradient_reduction", False):
+    # In-backward reduction replaces the strategy's post-hoc gradient
+    # pass with per-bucket pmeans issued inside the backward; it is
+    # therefore only defined for strategies whose aggregation IS the
+    # replica mean, and it cannot coexist with reducers that own
+    # reduction granularity themselves (ref: batch_allreduce.py:300-317
+    # selects exactly one algorithm).
+    if p.variable_update not in ("replicated", "distributed_replicated",
+                                 "parameter_server",
+                                 "collective_all_reduce",
+                                 "distributed_all_reduce", "horovod"):
+      raise ParamError(
+          "--overlap_gradient_reduction requires a replicated-family "
+          f"--variable_update (got {p.variable_update!r}): "
+          "independent/gossip modes have no gradient reduction to "
+          "overlap")
+    if p.variable_update == "parameter_server" and not p.cross_replica_sync:
+      raise ParamError(
+          "--overlap_gradient_reduction cannot be combined with async "
+          "parameter_server (--cross_replica_sync=false): the async path "
+          "consumes each replica's UNAVERAGED gradient (train_step.py "
+          "sequential_apply / psum-sum collapse); in-backward pmeans "
+          "would silently average them. Use a synchronous "
+          "--variable_update")
+    for flag, name in ((p.all_reduce_spec, "--all_reduce_spec"),
+                       (p.gradient_repacking, "--gradient_repacking"),
+                       (p.agg_small_grads_max_bytes > 0,
+                        "--agg_small_grads_max_bytes"),
+                       (p.hierarchical_copy, "--hierarchical_copy")):
+      if flag:
+        raise ParamError(
+            f"--overlap_gradient_reduction cannot be combined with "
+            f"{name}: each reducer owns the reduction granularity "
+            "(ref: batch_allreduce.py:300-317 selects one algorithm); "
+            "the overlap path's granularity lever is --reduce_bucket_mb")
+    if p.track_grad_noise_scale:
+      raise ParamError(
+          "--overlap_gradient_reduction cannot be combined with "
+          "--track_grad_noise_scale: the noise-scale estimator contrasts "
+          "PRE-reduction per-replica gradients with their replica mean "
+          "(elastic.noise_scale_stats), and in-backward reduction never "
+          "materializes the pre-reduction tree. Cost of the exclusion: "
+          "use the post-hoc default when monitoring noise scale")
   if p.hierarchical_copy and p.gradient_repacking:
     raise ParamError(
         "--hierarchical_copy cannot be combined with --gradient_repacking "

@@ -13,14 +13,21 @@ Usage:
     python run_tests.py --full_tests             # everything non-process
     python run_tests.py --run_distributed_tests  # process-spawning suite
     python run_tests.py --report-slowest[=N]     # + top-N duration table
+    python run_tests.py --check-tiering          # FAIL on >60s non-slow tests
 """
 
 import argparse
 import os
+import re
 import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.abspath(__file__))
+
+# The tiering rule from CLAUDE.md: a test outside the @pytest.mark.slow
+# marker must stay under this call duration, or the tier-1 suite
+# outgrows its 870 s wall budget one commit at a time.
+TIER1_TEST_BUDGET_S = 60.0
 
 # Process-spawning suites (kfrun + jax.distributed subprocesses).
 DISTRIBUTED_TESTS = [
@@ -61,12 +68,35 @@ def build_pytest_args(args, pytest_args):
       # benchmark); --full_tests runs everything either way.
       marker = ["-m", "not slow"]
   durations = []
-  if args.report_slowest is not None:
+  if getattr(args, "check_tiering", False):
+    # Enforcement mode: report EVERY call at or above the 60 s rule so
+    # main() can fail the run on non-slow offenders (the fast tier's
+    # selection already excludes @pytest.mark.slow, so anything
+    # reported here violates CLAUDE.md's tiering rule).
+    durations = ["--durations=0",
+                 f"--durations-min={TIER1_TEST_BUDGET_S}"]
+  elif args.report_slowest is not None:
     # Wall-budget guardrail (the tier-1 suite has an 870 s budget): the
     # closing table names the tests to mark @pytest.mark.slow next.
     durations = [f"--durations={args.report_slowest}",
                  "--durations-min=1.0"]
   return ["-q"] + marker + durations + targets + pytest_args
+
+
+def tiering_violations(pytest_output: str,
+                       budget_s: float = TIER1_TEST_BUDGET_S):
+  """Parse pytest's durations table for call phases over ``budget_s``.
+
+  Feed it the output of a fast-tier run made with --check-tiering's
+  durations flags (--report-slowest data works too). Only the 'call'
+  phase counts -- setup/teardown time is fixture cost, not the test's
+  tiering decision. Returns [(seconds, test_id), ...] slowest first."""
+  viols = []
+  for line in pytest_output.splitlines():
+    m = re.match(r"\s*(\d+(?:\.\d+)?)s\s+call\s+(\S+)", line)
+    if m and float(m.group(1)) > budget_s:
+      viols.append((float(m.group(1)), m.group(2)))
+  return sorted(viols, reverse=True)
 
 
 def main(argv=None):
@@ -80,6 +110,13 @@ def main(argv=None):
                       help="print the N slowest tests (default 15) after "
                            "the run -- the budget guardrail for tiering "
                            "new tests")
+  parser.add_argument("--check-tiering", action="store_true",
+                      dest="check_tiering",
+                      help="run the fast tier and FAIL if any test "
+                           "outside the slow marker exceeds the "
+                           f"{TIER1_TEST_BUDGET_S:.0f} s rule (CLAUDE.md) "
+                           "-- the CI guard for the 870 s tier-1 wall "
+                           "budget")
   args, pytest_args = parser.parse_known_args(argv)
   if args.report_slowest is not None:
     try:
@@ -94,8 +131,28 @@ def main(argv=None):
     parser.error("--run_distributed_tests selects ONLY the "
                  "process-spawning suites; run the two invocations "
                  "separately (the reference gates them the same way)")
+  if args.check_tiering and (args.full_tests or args.run_distributed_tests):
+    parser.error("--check-tiering audits the FAST tier (the 60 s rule "
+                 "only applies to tests outside the slow marker); run "
+                 "it without --full_tests/--run_distributed_tests")
   cmd = [sys.executable, "-m", "pytest"] + build_pytest_args(
       args, pytest_args)
+  if args.check_tiering:
+    # Capture to parse the durations table; echo so the run still
+    # streams (at end -- enforcement is a CI mode, not a dev loop).
+    proc = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True)
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    viols = tiering_violations(proc.stdout)
+    if viols:
+      print(f"TIERING VIOLATIONS (> {TIER1_TEST_BUDGET_S:.0f} s outside "
+            "the slow marker; add @pytest.mark.slow or split the test):")
+      for secs, test_id in viols:
+        print(f"  {secs:8.2f}s  {test_id}")
+      return 1
+    print(f"tiering check OK: no non-slow test over "
+          f"{TIER1_TEST_BUDGET_S:.0f} s")
+    return proc.returncode
   return subprocess.call(cmd, cwd=REPO)
 
 

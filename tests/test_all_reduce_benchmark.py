@@ -48,3 +48,46 @@ def test_run_benchmark_smoke(spec):
   assert stats["average_time_per_step"] > 0
   assert stats["average_all_reduce_time"] > 0
   assert stats["num_tensors"] >= 1
+
+
+# -- the --sweep mode (the PERF round-5 table from one command) ---------------
+
+def test_sweep_device_counts():
+  assert arb.sweep_device_counts(8) == [2, 4, 8]
+  assert arb.sweep_device_counts(6) == [2, 4, 6]
+  assert arb.sweep_device_counts(2) == [2]
+  assert arb.sweep_device_counts(1) == [1]
+
+
+def test_run_sweep_emits_table_and_json_line(capsys):
+  import json
+  from kf_benchmarks_tpu.utils import log as log_util
+  params = params_lib.make_params(
+      device="cpu", num_devices=4, num_batches=2, num_warmup_batches=1,
+      iters_per_step=2, sweep=True, sweep_specs="psum,rsag",
+      sweep_sizes="1k,4k")
+  logs = []
+  orig = log_util.log_fn
+  log_util.log_fn = logs.append
+  try:
+    rows = arb.run_sweep(params)
+  finally:
+    log_util.log_fn = orig
+  # n in {2, 4} x 2 specs x 2 sizes.
+  assert len(rows) == 2 * 2 * 2
+  assert {r["spec"] for r in rows} == {"psum", "rsag"}
+  assert {r["bytes"] for r in rows} == {1024, 4096}
+  # all_reduce_ms is the k-vs-2k DIFFERENTIAL (dispatch cost cancels);
+  # on CPU cells it can clamp to the 0 noise floor.
+  assert all(r["step_ms"] > 0 and r["all_reduce_ms"] >= 0 for r in rows)
+  # Markdown table through the logger...
+  table_rows = [l for l in logs if l.startswith("| ") and "psum" in l]
+  assert len(table_rows) == 4
+  assert any(l.startswith("|---") for l in logs)
+  # ...and ONE scrapeable JSON line on stdout.
+  out_lines = [l for l in capsys.readouterr().out.splitlines()
+               if l.strip().startswith("{")]
+  assert len(out_lines) == 1
+  record = json.loads(out_lines[0])
+  assert record["metric"] == "all_reduce_sweep"
+  assert len(record["rows"]) == len(rows)

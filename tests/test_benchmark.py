@@ -215,3 +215,18 @@ def test_tpu_reachable_paths(monkeypatch):
   monkeypatch.setattr(subprocess, "run", fake_run(returncode=1))
   ok, detail = benchmark.tpu_reachable()
   assert not ok and "boom details" in detail
+
+
+def test_stats_carry_compile_and_dispatch_overhead():
+  """The BENCH-trajectory fields (round 8): compile_s is the first
+  dispatch call's wall time (blocks on trace+compile), and
+  dispatch_overhead_s averages the TIMED loop's per-dispatch host
+  cost -- both must be present and sane so bench.py's JSON line can
+  track compile latency and RTT amortization across rounds."""
+  _, stats = _run_and_scrape(num_batches=4)
+  assert stats["compile_s"] is not None and stats["compile_s"] > 0
+  assert stats["dispatch_overhead_s"] is not None
+  assert stats["dispatch_overhead_s"] > 0
+  # Compile dominates a first dispatch; a timed dispatch call must not
+  # include it (the warmup boundary clears the accumulator).
+  assert stats["dispatch_overhead_s"] < stats["compile_s"]

@@ -259,3 +259,87 @@ def test_batch_norm_model_runs_and_logs_semantics_note():
   logs2, _ = _run_and_scrape(num_grad_accum=2, num_batches=4,
                              num_warmup_batches=1)
   assert not [l for l in logs2 if "batch-norm model" in l]
+
+
+# -- compiled-HLO: ONE reduction collective per step ---------------------------
+
+def test_accum_emits_one_reduction_collective_per_step():
+  """PR 2's commit message claimed gradient accumulation pays ONE
+  reduction collective per step; pin it at the compiled-HLO level.
+  With the packed default-path reducer (agg_small_grads packs every
+  leaf into one vector) the M=4 step carries exactly ONE non-scalar
+  all-reduce -- outside the microbatch scan's while body -- and the
+  M=1 program is identical in collective count (the scalar all-reduces
+  are the loss/lr metric pmeans, not gradient traffic)."""
+  import optax
+  import flax.linen as nn
+  from kf_benchmarks_tpu import train_step as train_step_lib
+  from kf_benchmarks_tpu.models.model import Model
+  from kf_benchmarks_tpu.parallel import strategies
+  from kf_benchmarks_tpu.parallel.mesh import build_mesh
+
+  class _TinyModule(nn.Module):
+
+    @nn.compact
+    def __call__(self, x):
+      h = nn.tanh(nn.Dense(8, name="l0")(x))
+      return nn.Dense(4, name="head")(h), None
+
+  class _TinyModel(Model):
+
+    def __init__(self, params=None):
+      super().__init__("tiny", 4, 0.05, params=params)
+
+    def make_module(self, nclass, phase_train, data_format="NHWC",
+                    dtype=jnp.float32, param_dtype=jnp.float32):
+      return _TinyModule()
+
+    def loss_function(self, result, labels):
+      logits, _ = result.logits
+      one_hot = jax.nn.one_hot(labels, logits.shape[-1])
+      return -jnp.mean(jnp.sum(
+          jax.nn.log_softmax(logits) * one_hot, axis=-1))
+
+    def accuracy_function(self, result, labels):
+      return {"top_1_accuracy": jnp.float32(0)}
+
+  def lowered_hlo(m):
+    p = params_lib.make_params(
+        device="cpu", num_devices=8, num_grad_accum=m, batch_size=4,
+        # Pack EVERY gradient leaf into one all-reduce (the
+        # default-path small-grad aggregation), so "one collective"
+        # is literal, not per-leaf.
+        agg_small_grads_max_bytes=1 << 30,
+        agg_small_grads_max_group=1000)
+    validation.validate_cross_flags(p)
+    model = _TinyModel(params=p)
+    module = model.make_module(4, True)
+    mesh = build_mesh(8, "cpu")
+    fns = train_step_lib.make_step_fns(
+        model, module, module, strategies.get_strategy(p),
+        optax.sgd(0.05), lambda s: jnp.float32(0.05), p, mesh)
+    init_state, train_step = fns[0], fns[1]
+    x = jnp.zeros((8 * 4, 8), jnp.float32)
+    y = jnp.zeros((8 * 4,), jnp.int32)
+    state = jax.jit(init_state)(jax.random.PRNGKey(0), x[:1])
+    return train_step.lower(state, x, y).compile().as_text()
+
+  def grad_collectives(hlo):
+    defs = [ln for ln in hlo.splitlines()
+            if re.search(r"=\s+\S+\s+all-reduce(-start)?\(", ln)]
+    # Gradient traffic is the non-scalar all-reduce; f32[] reductions
+    # are the step's metric pmeans.
+    grad = [ln for ln in defs
+            if not re.search(r"=\s+\w+\[\]\s+all-reduce", ln)]
+    return defs, grad
+
+  hlo_m4 = lowered_hlo(4)
+  defs4, grad4 = grad_collectives(hlo_m4)
+  assert len(grad4) == 1, (
+      f"expected exactly ONE gradient all-reduce per step, got "
+      f"{len(grad4)}")
+  assert not [ln for ln in defs4 if "while" in ln], (
+      "no collective may sit inside the microbatch scan body "
+      "(reduction is per STEP, not per microbatch)")
+  defs1, grad1 = grad_collectives(lowered_hlo(1))
+  assert len(grad1) == 1 and len(defs1) == len(defs4)

@@ -49,12 +49,14 @@ def test_per_op_profile_table(tmp_path, capsys):
   lines = table.splitlines()
   assert lines[0].startswith("Top 20 ops by estimated accelerator time")
   assert lines[1] == observability.PER_OP_TABLE_HEADER
-  # The table closes with the two whole-program lines the per-op rows
-  # cannot carry: per-dispatch RTT amortization (--steps_per_dispatch)
-  # and the roofline MFU ceiling (round 7).
-  assert lines[-2].startswith("dispatch overhead:")
-  assert lines[-1].startswith("MFU: ")
-  ranked = lines[2:-2]
+  # The table closes with the three whole-program lines the per-op rows
+  # cannot carry: per-dispatch RTT amortization (--steps_per_dispatch),
+  # the roofline MFU ceiling (round 7), and the comm/compute overlap
+  # fraction (round 8, --overlap_gradient_reduction).
+  assert lines[-3].startswith("dispatch overhead:")
+  assert lines[-2].startswith("MFU: ")
+  assert lines[-1].startswith("comm/compute overlap:")
+  ranked = lines[2:-3]
   assert len(ranked) > 1  # actual ranked rows
   # Ranked by estimated time, descending.
   times = [float(l.split()[1]) for l in ranked]
@@ -305,10 +307,13 @@ ENTRY e {
 """
   table = observability.per_op_table(hlo)
   lines = table.splitlines()
-  assert lines[-1].startswith("MFU: ")
-  assert lines[-2].startswith("dispatch overhead:")
+  # Closing order: dispatch overhead, MFU, comm/compute overlap
+  # (round 8 added the overlap-fraction line).
+  assert lines[-2].startswith("MFU: ")
+  assert lines[-3].startswith("dispatch overhead:")
+  assert lines[-1].startswith("comm/compute overlap:")
   # flops of the dot appear in the MFU line's flops/step field.
-  assert "5.243e+05" in lines[-1], lines[-1]
+  assert "5.243e+05" in lines[-2], lines[-2]
 
 
 def test_hbm_breakdown_line():
@@ -389,3 +394,107 @@ def test_run_tests_report_slowest_reclaims_swallowed_target(monkeypatch):
   assert "tests/test_observability.py" in cmd
   assert rt.main(["--report-slowest=5"]) == 0
   assert "--durations=5" in captured["cmd"]
+
+
+def test_run_tests_check_tiering_flags_and_parsing():
+  import argparse
+  import importlib.util
+  spec = importlib.util.spec_from_file_location(
+      "run_tests3", os.path.join(os.path.dirname(__file__), "..",
+                                 "run_tests.py"))
+  rt = importlib.util.module_from_spec(spec)
+  spec.loader.exec_module(rt)
+  ns = argparse.Namespace(full_tests=False, run_distributed_tests=False,
+                          report_slowest=None, check_tiering=True)
+  args = rt.build_pytest_args(ns, [])
+  # Enforcement mode reports EVERY call at/above the 60 s rule on the
+  # fast tier.
+  assert "--durations=0" in args
+  assert f"--durations-min={rt.TIER1_TEST_BUDGET_S}" in args
+  assert ["-m", "not slow"] == [a for a in args if a in ("-m", "not slow")]
+
+  output = """
+============================= slowest durations ===============================
+75.31s call     tests/test_heavy.py::test_way_over
+61.00s call     tests/test_heavy.py::test_just_over
+59.99s call     tests/test_ok.py::test_under
+70.00s setup    tests/test_fixture.py::test_slow_setup_is_not_a_violation
+"""
+  viols = rt.tiering_violations(output)
+  assert viols == [(75.31, "tests/test_heavy.py::test_way_over"),
+                   (61.0, "tests/test_heavy.py::test_just_over")]
+  assert rt.tiering_violations("no durations table") == []
+
+
+def test_run_tests_check_tiering_fails_on_violation(monkeypatch, capsys):
+  import importlib.util
+  import subprocess as sp
+  spec = importlib.util.spec_from_file_location(
+      "run_tests4", os.path.join(os.path.dirname(__file__), "..",
+                                 "run_tests.py"))
+  rt = importlib.util.module_from_spec(spec)
+  spec.loader.exec_module(rt)
+
+  class FakeProc:
+    def __init__(self, stdout):
+      self.stdout = stdout
+      self.stderr = ""
+      self.returncode = 0
+
+  outputs = {"out": "80.00s call tests/test_x.py::test_big\n1 passed\n"}
+
+  def fake_run(cmd, cwd=None, capture_output=None, text=None):
+    return FakeProc(outputs["out"])
+
+  monkeypatch.setattr(rt.subprocess, "run", fake_run)
+  assert rt.main(["--check-tiering"]) == 1
+  assert "TIERING VIOLATIONS" in capsys.readouterr().out
+  outputs["out"] = "12 passed\n"
+  assert rt.main(["--check-tiering"]) == 0
+  assert "tiering check OK" in capsys.readouterr().out
+  # The 60 s rule audits the fast tier only.
+  import pytest as _pytest
+  with _pytest.raises(SystemExit):
+    rt.main(["--check-tiering", "--full_tests"])
+
+
+# -- comm/compute overlap-fraction line ---------------------------------------
+
+_OVERLAP_HLO = """
+HloModule test
+
+%wide.body_spmd (p: (f32[8])) -> (f32[8]) {
+  %p = parameter(0)
+  %x = f32[8]{0} get-tuple-element((f32[8]) %p), index=0
+  %ar.1 = f32[8]{0} all-reduce(f32[8]{0} %x), replica_groups={}, to_apply=%add
+  ROOT %t = (f32[8]{0}) tuple(f32[8]{0} %ar.1)
+}
+
+ENTRY %main (a: f32[8]) -> f32[8] {
+  %a = parameter(0)
+  %w = (f32[8]{0}) while((f32[8]{0}) %tup), condition=%cond, body=%wide.body_spmd
+  %y = f32[8]{0} get-tuple-element((f32[8]) %w), index=0
+  ROOT %ar.2 = f32[8]{0} all-reduce(f32[8]{0} %y), replica_groups={}, to_apply=%add
+}
+"""
+
+
+def test_collective_overlap_stats_splits_in_loop_vs_trailing():
+  stats = observability.collective_overlap_stats(_OVERLAP_HLO)
+  assert stats["num_collectives"] == 2
+  # One of the two rides the while body (in-backward, overlappable).
+  assert 0.0 < stats["overlap_fraction"] < 1.0
+  assert abs(stats["overlap_fraction"] - 0.5) < 1e-6
+  line = observability.overlap_fraction_line(_OVERLAP_HLO)
+  assert "50.0% issued inside loop bodies" in line
+  assert "2 collectives" in line
+
+
+def test_overlap_fraction_line_no_collectives():
+  line = observability.overlap_fraction_line("ENTRY %main () -> f32[] {\n}")
+  assert "no collectives" in line
+
+
+def test_per_op_table_includes_overlap_line():
+  table = observability.per_op_table(_OVERLAP_HLO)
+  assert "comm/compute overlap:" in table.splitlines()[-1]
