@@ -75,6 +75,9 @@ def main():
       use_fp16=on_tpu,  # bfloat16 compute on TPU
       optimizer="momentum",
       display_every=10,
+      # Explicit opt-in (the bench has no train_dir, so auto would stay
+      # off): the one-line JSON carries the run-health aggregate.
+      health_stats=True,
   )
   params = benchmark.setup(params)
   bench = benchmark.BenchmarkCNN(params)
@@ -91,7 +94,7 @@ def main():
   # latency and RTT amortization, not just img/s.
   compile_s = stats.get("compile_s")
   dispatch_s = stats.get("dispatch_overhead_s")
-  print(json.dumps({
+  record = {
       "metric": metric,
       "value": round(value, 2),
       "unit": "images/sec",
@@ -99,7 +102,22 @@ def main():
       "compile_s": round(compile_s, 3) if compile_s is not None else None,
       "dispatch_overhead_s": (round(dispatch_s, 6)
                               if dispatch_s is not None else None),
-  }), flush=True)
+  }
+  # Run-health summary (telemetry.py): BENCH_*.json records whether the
+  # run was HEALTHY, not just fast -- a throughput number next to
+  # nonfinite_steps > 0 or a watchdog stall is a different story than
+  # the same number from a clean run. Absent (None) when --health_stats
+  # resolved off.
+  health = stats.get("health")
+  if health:
+    mgn = health.get("max_grad_norm")
+    record["health"] = {
+        "max_grad_norm": round(mgn, 4) if mgn is not None else None,
+        "nonfinite_steps": health.get("nonfinite_steps"),
+        "loss_scale_final": health.get("loss_scale_final"),
+        "watchdog_stalls": health.get("watchdog_stalls"),
+    }
+  print(json.dumps(record), flush=True)
 
 
 if __name__ == "__main__":

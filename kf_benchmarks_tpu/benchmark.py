@@ -19,10 +19,12 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from kf_benchmarks_tpu import checkpoint
+from kf_benchmarks_tpu import cluster as cluster_lib
 from kf_benchmarks_tpu import elastic as elastic_lib
 from kf_benchmarks_tpu import learning_rate
 from kf_benchmarks_tpu import observability
 from kf_benchmarks_tpu import optimizers
+from kf_benchmarks_tpu import telemetry as telemetry_lib
 from kf_benchmarks_tpu import train_step as train_step_lib
 from kf_benchmarks_tpu import validation
 from kf_benchmarks_tpu.data import datasets
@@ -307,6 +309,16 @@ class BenchmarkCNN:
     self.num_workers = jax.process_count()
     self.mesh = mesh_lib.build_mesh(self.num_devices, params.device)
     self.strategy = strategies.get_strategy(params)
+    # Training-health telemetry (telemetry.py): resolve the auto
+    # default (--health_stats unset) against the strategy's reduction
+    # semantics ONCE, so the step builder and the host-side recorder/
+    # watchdog see the same concrete decision.
+    hs, self._health_note = telemetry_lib.resolve_health_stats(
+        params, self.strategy)
+    if bool(params.health_stats) != hs or params.health_stats is None:
+      params = params._replace(health_stats=hs)
+      self.params = params
+    self._telemetry = None
     self.num_batches = self._get_num_batches()
     # Device-resident multi-step dispatch (--steps_per_dispatch=K): K
     # train steps per compiled program (train_step.py train_chunk), so
@@ -591,17 +603,29 @@ class BenchmarkCNN:
 
   def _benchmark_train(self) -> Dict[str, Any]:
     p = self.params
+    if self._health_note:
+      log_fn(self._health_note)
     init_state, train_step, eval_step, broadcast_init, train_chunk = \
         self._build()
     rng = jax.random.PRNGKey(p.tf_random_seed or 0)
     data_rng, init_rng = jax.random.split(rng)
     self._data_rng = data_rng
     next_batch = self._open_input(data_rng, "train")
+    # Flight recorder + stall watchdog for the whole build->train span
+    # (the watchdog's patient first-compile regime must cover the init
+    # and warmup compiles, not just the timed loop). None when the
+    # resolved --health_stats is off.
+    self._telemetry = telemetry_lib.TelemetrySession.create(
+        p, rank=cluster_lib.process_rank(), log_fn=log_fn,
+        num_ranks=max(self.num_workers, 1))
     try:
       return self._train_loop(init_state, train_step, eval_step,
                               broadcast_init, init_rng, next_batch,
                               train_chunk)
     finally:
+      if self._telemetry is not None:
+        self._telemetry.close()
+        self._telemetry = None
       self._input_stop()
 
   def _open_input(self, rng, subset: str):
@@ -660,6 +684,7 @@ class BenchmarkCNN:
   def _train_loop(self, init_state, train_step, eval_step, broadcast_init,
                   init_rng, next_batch, train_chunk=None) -> Dict[str, Any]:
     p = self.params
+    tele = getattr(self, "_telemetry", None)
     K = self.steps_per_dispatch
     chunked = K > 1 and train_chunk is not None
     synthetic = self.dataset.use_synthetic_gpu_inputs()
@@ -917,6 +942,15 @@ class BenchmarkCNN:
         sync.drain(metrics)
     log_fn("Warmup (compile + %d steps): %.1f s" %
            (warm_steps, time.time() - t0))
+    if tele is not None and self.num_warmup_batches:
+      # First heartbeat: compile + warmup completed (the drain above is
+      # a real value fetch, utils/sync.py) -- the watchdog leaves its
+      # patient first-compile regime here. With --num_warmup_batches=0
+      # no dispatch has run yet, so the beat is withheld and the
+      # watchdog stays in the patient regime through the first timed
+      # dispatch (which IS the first compile then, per the chunked
+      # warmup-split comment above).
+      tele.beat()
     # Base for globally-meaningful step numbers in metric/summary streams
     # (resumed runs must not restart their step axis at 1).
     start_step = int(state.step)
@@ -950,6 +984,20 @@ class BenchmarkCNN:
         chunk_times.append(done.chunk_interval)
       m = done.metrics
       loss = float(m[p.loss_type_to_report])
+      if tele is not None:
+        # One flight-recorder row per STEP (chunked dispatches resolve
+        # to per-step metrics host-side, utils/pipeline.py); heartbeat
+        # once per completed dispatch with its real wall interval. The
+        # pipeline's metric fetch IS the drain-semantics liveness
+        # signal (utils/sync.py) -- block_until_ready is never used.
+        tele.record(
+            step=start_step + done.index, loss=loss,
+            lr=m.get("learning_rate"), health=m.get("health"),
+            wall_ms=done.interval * 1e3, chunk_len=done.chunk_len,
+            rtt_ms=(dispatch_stats["call_times"][-1] * 1e3
+                    if dispatch_stats["call_times"] else None))
+        if done.chunk_end:
+          tele.beat(done.chunk_interval)
       if noise_ema is not None and "noise_scale_g2" in m:
         noise_ema.update(float(m["noise_scale_g2"]),
                          float(m["noise_scale_s"]))
@@ -974,13 +1022,20 @@ class BenchmarkCNN:
         last_display_len = len(step_train_times)
       if summary_writer is not None and i1 % p.save_summaries_steps == 0:
         scalars = {k: v for k, v in m.items() if np.ndim(v) == 0}
+        # The packed health vector expands into the SAME health/<key>
+        # scalars the flight-recorder rows carry (one shared schema,
+        # telemetry.py).
+        scalars.update(telemetry_lib.health_scalars(m))
         summary_writer.write_scalars(start_step + i1, scalars)
         if summary_writer.verbosity >= 2:  # slice only when it will be used
           # Histograms read the live state (may be up to `lag` steps ahead
           # of i1 -- histogram verbosity is a debugging surface).
           summary_writer.write_histograms(
               start_step + i1,
-              jax.tree.map(lambda x: x[0], state.params), "params")
+              jax.tree.map(lambda x: x[0], state.params), "params",
+              stacked_prefixes=tuple(
+                  getattr(self.model, "scanned_param_prefixes", ())
+                  or ()))
 
     # Step-keyed schedule predicates. The SAME functions feed both the
     # dispatch-length planner (_event_due) and the post-dispatch due
@@ -1271,6 +1326,18 @@ class BenchmarkCNN:
           os.unlink(measured_path)
       except Exception as e:  # pragma: no cover - defensive tail
         log_fn(f"measured per-op profile failed (non-fatal): {e!r}")
+    # Run-health summary (telemetry.py): the aggregate the one-line
+    # BENCH JSON carries next to throughput (bench.py).
+    health_summary = None
+    if tele is not None:
+      health_summary = tele.summary()
+      if bench_logger is not None and \
+          health_summary.get("max_grad_norm") is not None:
+        bench_logger.log_metric(
+            "max_grad_norm", health_summary["max_grad_norm"],
+            global_step=start_step + num_steps,
+            extras={"nonfinite_steps": health_summary["nonfinite_steps"],
+                    "watchdog_stalls": health_summary["watchdog_stalls"]})
     # Final checkpoint (ref: benchmark_cnn.py:2374-2378).
     if p.train_dir:
       checkpoint.save_checkpoint(p.train_dir, state, p.max_ckpts_to_keep)
@@ -1300,6 +1367,10 @@ class BenchmarkCNN:
         "restart_for_resize": restart_requested,
         "reshape_events": reshape_events,
         "grad_noise_scale": noise_ema.b_simple if noise_ema else None,
+        # Training-health aggregate (None when --health_stats resolved
+        # off): max grad norm, nonfinite_steps, loss_scale_final,
+        # watchdog_stalls, anomaly_dumps (telemetry.py).
+        "health": health_summary,
         "state": state,
     }
 

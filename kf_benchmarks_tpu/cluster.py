@@ -23,6 +23,29 @@ from __future__ import annotations
 from typing import List, Optional
 
 
+def process_rank() -> int:
+  """Stable per-process rank for telemetry record tagging.
+
+  Flight-recorder rows carry it, and rank 0 owns the aggregated window
+  at exit (telemetry.py aggregate_rank_windows). Under the kfrun
+  launcher the env rank hint is authoritative even before
+  jax.distributed initializes (ref: kungfu-run peer-list env
+  propagation, SURVEY 2.9); otherwise the JAX process index -- the same
+  chief-election convention as parallel/kungfu.py current_rank
+  (ref call: benchmark_cnn.py:2044-2048), but a PROCESS index, not a
+  device-weighted one: telemetry files are per process.
+  """
+  import os
+  hint = os.environ.get("KFCOORD_RANK_HINT")
+  if hint:
+    try:
+      return int(hint)
+    except ValueError:
+      pass
+  import jax
+  return jax.process_index()
+
+
 class BaseClusterManager:
   """(ref: cnn_util.py:201-229)."""
 

@@ -243,6 +243,10 @@ class TransformerLMModel(model_lib.Model):
       raise ValueError(
           f"KF_TRANSFORMER_LM_LAYERS must be 'scan' or 'loop', got "
           f"{layers!r}")
+    # Scan-over-layers params carry a leading depth axis under 'blocks'
+    # (PR 2): observability.SummaryWriter unstacks histogram keys per
+    # layer via this attribute (tests/test_observability.py).
+    self.scanned_param_prefixes = ("blocks",) if layers == "scan" else ()
     # --overlap_gradient_reduction: hook the scanned layer stack so
     # each backward scan iteration reduces its OWN layer's gradient
     # slice inside the loop body (ops/overlap.py scan_block_hook). The

@@ -692,25 +692,45 @@ class SummaryWriter:
         clean[k] = v
     self._write({"step": step, "scalars": clean})
 
-  def write_histograms(self, step: int, tree, prefix: str) -> None:
+  def write_histograms(self, step: int, tree, prefix: str,
+                       stacked_prefixes=()) -> None:
+    """``stacked_prefixes`` names top-level tree keys whose leaves are
+    scan-stacked over layers (nn.scan rebuilt transformer_lm's blocks
+    with a leading depth axis): those unstack into per-layer-indexed
+    keys (``params/blocks/layer3/...``) so the histogram stream reads
+    per layer instead of blending every depth into one histogram."""
     if self.verbosity < 2:
       return
     leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
-    if self.verbosity < 3:
-      leaves = leaves[:self.MAX_TIER2_LEAVES]
+    # Tier-2 bound on EMITTED histograms (unstacked per-layer entries
+    # each count): truncating the leaf list instead would let one
+    # scan-stacked leaf fan out into num_layers records past the cap.
+    cap = self.MAX_TIER2_LEAVES if self.verbosity < 3 else None
+
+    def _hist(arr):
+      counts, edges = np.histogram(arr, bins=20)
+      return {"counts": counts.tolist(),
+              "min": float(edges[0]), "max": float(edges[-1]),
+              "mean": float(arr.mean()), "std": float(arr.std())}
+
     hists = {}
     for path, leaf in leaves:
+      if cap is not None and len(hists) >= cap:
+        break
       # Conventional slash names ("params/conv1/kernel"), not the
       # bracketed keystr/str rendering ("['conv1']['kernel']").
       parts = [str(getattr(p, "key", getattr(p, "name",
                                              getattr(p, "idx", p))))
                for p in path]
-      name = "/".join([prefix] + parts)
-      arr = np.asarray(leaf, np.float32).ravel()
+      arr = np.asarray(leaf, np.float32)
       if arr.size == 0:
         continue
-      counts, edges = np.histogram(arr, bins=20)
-      hists[name] = {"counts": counts.tolist(),
-                     "min": float(edges[0]), "max": float(edges[-1]),
-                     "mean": float(arr.mean()), "std": float(arr.std())}
+      if parts and parts[0] in stacked_prefixes and arr.ndim >= 2:
+        for i in range(arr.shape[0]):
+          if cap is not None and len(hists) >= cap:
+            break
+          hists["/".join([prefix, parts[0], f"layer{i}"] + parts[1:])] \
+              = _hist(arr[i].ravel())
+        continue
+      hists["/".join([prefix] + parts)] = _hist(arr.ravel())
     self._write({"step": step, "histograms": hists})

@@ -273,6 +273,43 @@ flags.DEFINE_boolean("staged_vars", False,
                      "variable_mgr.py:246-274 StagedVariableGetter).")
 flags.DEFINE_string("train_dir", None,
                     "Checkpoint/summary directory (ref :585-588).")
+flags.DEFINE_boolean("health_stats", None,
+                     "In-step training-health stats (telemetry.py): the "
+                     "train step additionally returns a compact f32 "
+                     "vector (global grad norm, update/param norm ratio, "
+                     "non-finite leaf count, loss scale + skip flag) "
+                     "computed inside the compiled program and packed "
+                     "into the existing loss pmean, so it adds NO extra "
+                     "collective (pinned in tests/test_telemetry.py); "
+                     "feeds the flight recorder and stall watchdog. "
+                     "Unset = auto: on for training runs that reduce "
+                     "gradients replica-synchronously (replicated family "
+                     "/ kungfu sync_sgd) AND have a telemetry sink "
+                     "(--train_dir or --benchmark_log_dir); off with a "
+                     "note for per-replica/gossip/async modes, off "
+                     "quietly for sink-less runs (the readout rides the "
+                     "step's tail, so it is not free). No reference "
+                     "analog -- its observability is post-hoc only "
+                     "(SURVEY 5.1/9; ref: benchmark_cnn.py:585-620 "
+                     "summaries/benchmark logs).")
+flags.DEFINE_float("health_grad_norm_sigma", 6.0,
+                   "Flight-recorder anomaly threshold: a step whose "
+                   "global grad norm exceeds the trailing window's mean "
+                   "by this many standard deviations dumps the window "
+                   "(telemetry.py).", lower_bound=0.1)
+flags.DEFINE_integer("flight_recorder_window", 64,
+                     "Per-step records the flight recorder retains (and "
+                     "continuously rewrites to train_dir/"
+                     "flight_recorder.jsonl); the post-mortem window "
+                     "dumped on anomaly/signal/exit (telemetry.py).",
+                     lower_bound=4)
+flags.DEFINE_float("stall_watchdog_factor", 10.0,
+                   "Mid-run stall threshold: silence beyond this factor "
+                   "times the trailing mean chunk wall emits a watchdog "
+                   "diagnostic (never a kill -- a kill mid-claim is the "
+                   "documented tunnel-wedge trigger). 0 disables the "
+                   "watchdog thread; the first compile is always exempt "
+                   "(patient, log-only) (telemetry.py).", lower_bound=0)
 flags.DEFINE_integer("summary_verbosity", 0,
                      "0-3: none / scalars / grad histograms / everything "
                      "(ref :589-593).", lower_bound=0, upper_bound=3)

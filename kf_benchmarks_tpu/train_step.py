@@ -36,6 +36,7 @@ import flax
 import optax
 
 from kf_benchmarks_tpu import elastic as elastic_lib
+from kf_benchmarks_tpu import telemetry as telemetry_lib
 from kf_benchmarks_tpu.ops import overlap as overlap_lib
 from kf_benchmarks_tpu.parallel.mesh import REPLICA_AXIS
 
@@ -185,6 +186,16 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
         f"{num_grad_accum} keeps reduction post-hoc on the accumulated "
         "tree (one collective per step is the pinned invariant); "
         "in-backward hooks disengaged")
+  # --health_stats: in-step device health stats (telemetry.py). The
+  # step builder takes the CONCRETE boolean benchmark.py resolved
+  # (None/auto never reaches here from the runtime); direct callers
+  # passing an unresolved None get the exact legacy program, which is
+  # what keeps the collective-count HLO pins in older tests meaningful.
+  # (sequential_apply has no single optimizer-update tree to measure;
+  # async PS is already health-rejected by validation/resolve -- this
+  # keeps direct make_step_fns callers safe too.)
+  health_stats = (bool(getattr(params, "health_stats", None)) and
+                  not getattr(strategy, "sequential_apply", False))
   # Top-level param-tree keys whose gradients the MODULE already
   # reduces in-backward (e.g. transformer_lm's scanned 'blocks' stack
   # hooks per layer inside the nn.scan); the step-level buckets skip
@@ -481,11 +492,48 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
       normal_steps = state.loss_scale_normal_steps
 
     lr = lr_fn(state.step)
-    metrics = {
-        "base_loss": lax.pmean(base_loss, REPLICA_AXIS),
-        "total_loss": lax.pmean(total_loss, REPLICA_AXIS),
-        "learning_rate": lr,
-    }
+    if health_stats:
+      # In-step health stats (telemetry.py): grad norm, update/param
+      # ratio, non-finite leaf count, loss scale + skip flag -- all
+      # read from the step's post-reduction values, so they are
+      # replica-identical for the replica-synchronous strategies
+      # validation admits. Each replica reduces a 1/n SLICE of every
+      # tree (telemetry.health_partials) and the pre-scaled partial
+      # sums ride the LOSS pmean: one f32 vector all-reduce replaces
+      # the two scalar loss pmeans, so the health-on program carries
+      # NO extra collective (acceptance-pinned in
+      # tests/test_telemetry.py) and no replicated full-tree passes.
+      # Elementwise, the vector all-reduce computes bit-identical loss
+      # values to the scalar ones (equivalence pinned in the same
+      # tests). ``updates`` exists on every health-admitted path:
+      # sequential_apply (async PS) is rejected/auto-disabled by
+      # validation.py and resolve_health_stats.
+      skipped = (1.0 - fresh_finite.astype(jnp.float32)
+                 if fresh_finite is not None else jnp.float32(0.0))
+      # The fresh-grad overflow skip only suppresses the applied
+      # update on the non-relaxed path (the relaxed bank admits finite
+      # gradients only, so its apply always lands).
+      suppressed = jnp.float32(0.0) if relaxed else skipped
+      packed = lax.pmean(
+          jnp.concatenate([
+              jnp.stack([base_loss.astype(jnp.float32),
+                         total_loss.astype(jnp.float32)]),
+              telemetry_lib.health_partials(
+                  grads, model_params, updates, REPLICA_AXIS)]),
+          REPLICA_AXIS)
+      metrics = {
+          "base_loss": packed[0],
+          "total_loss": packed[1],
+          "learning_rate": lr,
+          "health": telemetry_lib.health_finalize(
+              packed[2:], new_scale, skipped, suppressed),
+      }
+    else:
+      metrics = {
+          "base_loss": lax.pmean(base_loss, REPLICA_AXIS),
+          "total_loss": lax.pmean(total_loss, REPLICA_AXIS),
+          "learning_rate": lr,
+      }
     if steps_per_dispatch > 1:
       # Replica-mean global norm of the reduced gradients (under relaxed
       # consistency: of the APPLIED, one-step-stale bank) -- the
@@ -494,9 +542,16 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
       # otherwise probe with per-step fetches. K=1 omits it so the
       # single-step program stays the exact program behind PERF.md's
       # pinned envelope numbers.
-      metrics["grad_norm"] = lax.pmean(
-          jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                       for g in jax.tree.leaves(grads))), REPLICA_AXIS)
+      if "health" in metrics:
+        # The health vector already carries this exact norm (same grads
+        # tree, sharded reduction): reuse it rather than paying a second,
+        # full-tree replicated square-sum pass -- the replicated pass is
+        # the ~2x-step-time cost _sharded_sumsq exists to avoid.
+        metrics["grad_norm"] = metrics["health"][0]
+      else:
+        metrics["grad_norm"] = lax.pmean(
+            jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                         for g in jax.tree.leaves(grads))), REPLICA_AXIS)
     if params.print_training_accuracy:
       # Under microbatching the per-microbatch scalar accuracies were
       # averaged inside the scan (equal microbatch sizes make that the

@@ -360,6 +360,37 @@ def validate_cross_flags(params) -> None:
           "(elastic.noise_scale_stats), and in-backward reduction never "
           "materializes the pre-reduction tree. Cost of the exclusion: "
           "use the post-hoc default when monitoring noise scale")
+  if getattr(p, "health_stats", None):
+    # Explicit --health_stats (unset = auto-resolve, telemetry.py): the
+    # in-step stats read the APPLIED gradient tree and are only global
+    # values when that tree is replica-identical -- i.e. when the
+    # strategy reduces gradients replica-synchronously. Modes below
+    # would silently report replica-LOCAL norms as global health.
+    if p.eval or p.forward_only:
+      raise ParamError(
+          "--health_stats applies to training only (the stats are "
+          "computed from the step's gradient tree); it cannot be "
+          "combined with --eval or --forward_only")
+    if p.variable_update == "independent":
+      raise ParamError(
+          "--health_stats requires replica-synchronous gradient "
+          "reduction: --variable_update=independent never reduces, so "
+          "each replica's 'global' grad norm would be its own local "
+          "one. Drop the flag (auto-off) or use a replicated-family "
+          "mode")
+    if p.variable_update == "kungfu" and p.kungfu_option != "sync_sgd":
+      raise ParamError(
+          "--health_stats cannot be combined with --kungfu_option="
+          f"{p.kungfu_option}: gossip/model-averaging modes keep "
+          "per-replica gradient trees (parallel/strategies.py); only "
+          "sync_sgd reduces replica-synchronously")
+    if p.variable_update == "parameter_server" and not p.cross_replica_sync:
+      raise ParamError(
+          "--health_stats cannot be combined with async "
+          "parameter_server (--cross_replica_sync=false): the "
+          "sequential-apply path consumes each replica's UNAVERAGED "
+          "gradient (train_step.py sequential_apply), so no replica-"
+          "identical reduced tree exists for the stats to read")
   if p.hierarchical_copy and p.gradient_repacking:
     raise ParamError(
         "--hierarchical_copy cannot be combined with --gradient_repacking "

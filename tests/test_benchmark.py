@@ -217,6 +217,36 @@ def test_tpu_reachable_paths(monkeypatch):
   assert not ok and "boom details" in detail
 
 
+def test_telemetry_never_interleaves_inside_step_lines(tmp_path):
+  """Scrape guard (round 9): the telemetry layer (flight-recorder
+  diagnosis lines, watchdog output, the auto-resolution note) emits
+  whole lines of its own and NEVER alters or interleaves inside the
+  exact reference step-line format the e2e tests scrape. Driven with a
+  divergent LR so a mid-run recorder dump actually fires between step
+  lines."""
+  logs, stats = _run_and_scrape(num_batches=6, display_every=1,
+                                train_dir=str(tmp_path),
+                                init_learning_rate=1e30)
+  # Every line carrying the step-line marker is a full step line or the
+  # reference's own closing total -- nothing prepended, appended, or
+  # spliced by telemetry.
+  marker_lines = [l for l in logs if "images/sec:" in l]
+  assert all(STEP_RE.match(l) or TOTAL_RE.match(l) for l in marker_lines), \
+      marker_lines
+  step_lines = [l for l in marker_lines if STEP_RE.match(l)]
+  assert sum(bool(TOTAL_RE.match(l)) for l in marker_lines) == 1
+  assert [int(STEP_RE.match(l).group(1)) for l in step_lines] == \
+      [1, 2, 3, 4, 5, 6]
+  # The telemetry emission happened (the injected divergence dumped),
+  # on lines of its own.
+  tele_lines = [l for l in logs if l.startswith("flight recorder:")]
+  assert tele_lines, logs
+  assert not any("images/sec" in l for l in tele_lines)
+  # The header/banner contract is untouched too.
+  assert any(l.startswith("Step\tImg/sec") for l in logs)
+  assert stats["num_steps"] == 6
+
+
 def test_stats_carry_compile_and_dispatch_overhead():
   """The BENCH-trajectory fields (round 8): compile_s is the first
   dispatch call's wall time (blocks on trace+compile), and

@@ -152,6 +152,7 @@ def test_losses_match_monolithic_step():
   assert int(stats1["state"].step) == int(stats4["state"].step)
 
 
+@pytest.mark.slow  # ~22 s: tiered for the 870 s tier-1 wall budget
 def test_composes_with_steps_per_dispatch_and_warmup_tail():
   """Acceptance + satellite: --num_grad_accum=2 under
   --steps_per_dispatch=4 with a warmup that is NOT a multiple of K
@@ -244,6 +245,7 @@ def test_grad_program_peak_temp_shrinks():
   assert peak_accum < peak_mono, (peak_accum, peak_mono)
 
 
+@pytest.mark.slow  # ~24 s: tiered for the 870 s tier-1 wall budget
 def test_batch_norm_model_runs_and_logs_semantics_note():
   """Batch-norm models microbatch with per-microbatch BN statistics --
   a semantics change vs M=1, not an equivalence (the EMA also advances

@@ -203,6 +203,7 @@ def test_nasnet_module_accepts_progress():
   assert not np.allclose(np.asarray(l0), np.asarray(l1))
 
 
+@pytest.mark.slow  # ~21 s: tiered for the 870 s tier-1 wall budget
 def test_inception3_aux_head():
   """The auxiliary head produces aux logits and a 0.4-weighted loss
   contribution (ref: models/model.py:297-302, inception_model.py:95-104)."""

@@ -147,6 +147,54 @@ def test_summary_tiers(tmp_path):
   assert sum(first_hist["counts"]) > 0
 
 
+def test_write_histograms_unstacks_scanned_layers(tmp_path):
+  """Scan-stacked params (PR 2 rebuilt transformer_lm layers on nn.scan,
+  so 'blocks' leaves carry a leading layer axis) must unstack into
+  per-layer-indexed histogram keys instead of blending all depths into
+  one histogram; non-stacked leaves keep their plain keys."""
+  rng = np.random.RandomState(0)
+  layers = 4
+  tree = {
+      "blocks": {"mlp": {"kernel": rng.randn(layers, 3, 5).astype(
+          np.float32)}},
+      "embed": {"kernel": rng.randn(7, 3).astype(np.float32)},
+  }
+  w = observability.SummaryWriter(str(tmp_path), verbosity=3)
+  w.write_histograms(11, tree, "params", stacked_prefixes=("blocks",))
+  events = [json.loads(l) for l in open(os.path.join(str(tmp_path),
+                                                     "events.jsonl"))]
+  hists = events[0]["histograms"]
+  layer_keys = [f"params/blocks/layer{i}/mlp/kernel"
+                for i in range(layers)]
+  assert set(hists) == set(layer_keys) | {"params/embed/kernel"}
+  # Each per-layer histogram summarizes THAT layer's slice.
+  for i, key in enumerate(layer_keys):
+    sl = tree["blocks"]["mlp"]["kernel"][i]
+    assert hists[key]["mean"] == pytest.approx(float(sl.mean()), rel=1e-6)
+    assert sum(hists[key]["counts"]) == sl.size
+  # Without the prefix the stacked leaf stays one blended histogram
+  # (the pre-round-9 behavior, still the default).
+  w2 = observability.SummaryWriter(str(tmp_path / "plain"), verbosity=3)
+  w2.write_histograms(11, tree, "params")
+  ev2 = [json.loads(l) for l in open(os.path.join(str(tmp_path / "plain"),
+                                                  "events.jsonl"))]
+  assert "params/blocks/mlp/kernel" in ev2[0]["histograms"]
+
+
+def test_transformer_lm_exposes_scanned_prefixes(monkeypatch):
+  """The scanned model names its depth-stacked top-level keys so the
+  benchmark loop can pass them to write_histograms; the unrolled-loop
+  variant exposes none."""
+  from kf_benchmarks_tpu.models import model_config
+  model = model_config.get_model_config("transformer_lm", "synthetic")
+  model.make_module(nclass=1, phase_train=True)
+  assert model.scanned_param_prefixes == ("blocks",)
+  monkeypatch.setenv("KF_TRANSFORMER_LM_LAYERS", "loop")
+  model2 = model_config.get_model_config("transformer_lm", "synthetic")
+  model2.make_module(nclass=1, phase_train=True)
+  assert model2.scanned_param_prefixes == ()
+
+
 def test_summary_verbosity_zero_writes_nothing(tmp_path):
   train_dir = str(tmp_path / "train")
   _run(tmp_path, train_dir=train_dir, save_summaries_steps=2,
@@ -498,3 +546,54 @@ def test_overlap_fraction_line_no_collectives():
 def test_per_op_table_includes_overlap_line():
   table = observability.per_op_table(_OVERLAP_HLO)
   assert "comm/compute overlap:" in table.splitlines()[-1]
+
+
+# Collective opcodes beyond all-reduce: as tensor/sequence/expert
+# parallel modes land, their reduce-scatter / all-gather /
+# collective-permute traffic must count toward the overlap-fraction
+# accounting too (only all-reduce paths were pinned before round 9).
+_MULTI_COLLECTIVE_HLO = """
+HloModule multi
+
+%loop.body (p: (f32[64])) -> (f32[64]) {
+  %p = parameter(0)
+  %x = f32[64]{0} get-tuple-element((f32[64]) %p), index=0
+  %cp = f32[64]{0} collective-permute(f32[64]{0} %x), source_target_pairs={{0,1},{1,0}}
+  ROOT %t = (f32[64]{0}) tuple(f32[64]{0} %cp)
+}
+
+ENTRY %main (a: f32[64], b: f32[128]) -> f32[128] {
+  %a = parameter(0)
+  %b = parameter(1)
+  %w = (f32[64]{0}) while((f32[64]{0}) %tup), condition=%cond, body=%loop.body
+  %rs = f32[16]{0} reduce-scatter(f32[64]{0} %a), dimensions={0}, to_apply=%add
+  %ag = f32[128]{0} all-gather-start(f32[16]{0} %rs), dimensions={0}
+  ROOT %agd = f32[128]{0} all-gather-done(f32[128]{0} %ag)
+}
+"""
+
+
+def test_collective_overlap_stats_counts_non_allreduce_opcodes():
+  stats = observability.collective_overlap_stats(_MULTI_COLLECTIVE_HLO)
+  # collective-permute (in-loop), reduce-scatter, all-gather-start; the
+  # -done half of the async pair is not a second collective.
+  assert stats["num_collectives"] == 3
+  assert stats["comm_s"] > 0
+  # Only the collective-permute rides the while body.
+  permute_bytes = 64 * 4
+  assert stats["comm_in_loop_s"] == pytest.approx(
+      permute_bytes / observability.TPU_PEAK_BYTES_PER_S)
+  assert 0.0 < stats["overlap_fraction"] < 1.0
+  line = observability.overlap_fraction_line(_MULTI_COLLECTIVE_HLO)
+  assert "3 collectives" in line
+
+
+def test_per_op_costs_rows_for_non_allreduce_collectives():
+  rows = {r["opcode"]: r for r in observability.per_op_costs(
+      _MULTI_COLLECTIVE_HLO)}
+  assert "reduce-scatter" in rows and "collective-permute" in rows
+  assert rows["reduce-scatter"]["bytes"] == (16 + 64) * 4
+  assert rows["collective-permute"]["bytes"] == (64 + 64) * 4
+  # Bandwidth-bound ops: no flops, ranked by bytes.
+  assert rows["reduce-scatter"]["flops"] == 0.0
+  assert rows["reduce-scatter"]["est_time_s"] > 0

@@ -123,6 +123,7 @@ def test_zigzag_ring_gradients_match_full_attention():
                                rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow  # ~26 s: tiered for the 870 s tier-1 wall budget
 def test_zigzag_inner_block_matches_full():
   # The K/V sub-block tiling composed into the zigzag ring: stripes
   # scan their travelling K/V in tiles, result stays exact causal

@@ -177,6 +177,7 @@ def test_chunked_losses_bit_identical_to_single_step():
   assert stats8["num_chunks"] == 2  # 16 steps, 1 warmup-rounded... timed 16/8
 
 
+@pytest.mark.slow  # heaviest file member (~28 s): tiered for the 870 s budget
 def test_chunked_equivalence_with_tail_and_fp16_state():
   """A non-multiple run length (tail steps run the single-step program),
   a non-multiple warmup (q=2 chunks + r=2 singles must total EXACTLY 10
