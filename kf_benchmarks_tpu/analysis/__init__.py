@@ -1,0 +1,35 @@
+"""Static analysis of the framework: program contracts + hazard lint.
+
+TPU-NATIVE-ONLY subsystem (no single reference file to cite; the
+reference analog is its reliance on GRAPH-MODE STRUCTURE -- variable
+scopes, collective op counts, staging-area wiring -- asserted by
+inspecting the built tf.Graph before any session ran. Here the
+compiled XLA program plays the graph's role, so the same guarantees
+are checked by lowering ``jit`` programs without executing them. See the
+graph-structure-assumptions section of MIGRATION.md and COVERAGE.md.)
+
+Two layers:
+
+* ``contracts`` / ``audit`` / ``baseline`` -- the **program-contract
+  auditor**: trace (never execute) the train step for a
+  ``BenchmarkParams`` config on the abstract 8-device mesh via
+  ``jit(...).lower(...).compile()``, extract a structured
+  :class:`~kf_benchmarks_tpu.analysis.contracts.ProgramContract`
+  (collective inventory with wire dtypes and loop placement, host
+  transfers, optimizer-apply scope, donation, largest live buffers),
+  check every earned invariant per config (``audit``), and diff
+  against checked-in goldens (``baseline``,
+  ``tests/golden_contracts/*.json``).
+
+* ``lint`` -- the **hazard lint**: an AST pass over the repo encoding
+  CLAUDE.md's hard-won environment rules (``jax.block_until_ready``
+  banned outside ``utils/sync.py``, version gates need a comment
+  naming the missing API, kill-based timeouts around TPU subprocesses
+  banned in tests, step-line format literals single-sourced, flags
+  must be cross-validated or carry an explicit no-validation marker,
+  reference citations per module). Pure stdlib: importing ``lint``
+  never imports jax.
+
+CLI: ``python -m kf_benchmarks_tpu.analysis`` (see ``__main__``);
+CI entry: ``python run_tests.py --audit``.
+"""

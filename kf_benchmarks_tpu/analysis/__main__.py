@@ -1,0 +1,116 @@
+"""CLI: ``python -m kf_benchmarks_tpu.analysis [lint|audit|all]``.
+
+CPU-only, device-free: the audit lowers+compiles step programs on an
+8-virtual-device host mesh (same recipe as tests/conftest.py) and never
+executes one; the lint is a pure AST pass. Exit status is nonzero on
+any lint violation, audit-rule violation, or golden diff -- the CI
+contract ``run_tests.py --audit`` relies on.
+
+    python -m kf_benchmarks_tpu.analysis              # lint + audit
+    python -m kf_benchmarks_tpu.analysis lint
+    python -m kf_benchmarks_tpu.analysis audit [--configs a,b] [--json F]
+    python -m kf_benchmarks_tpu.analysis audit --write-goldens
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _force_virtual_cpu_mesh() -> None:
+  """The conftest recipe (tests/conftest.py): XLA_FLAGS must carry the
+  host-device count before the backend initializes, and the platform
+  flip must happen through jax.config AFTER import (overriding the
+  pinned JAX_PLATFORMS env breaks the axon relay -- CLAUDE.md)."""
+  xla_flags = os.environ.get("XLA_FLAGS", "")
+  if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+  import jax
+  jax.config.update("jax_platforms", "cpu")
+
+
+def run_lint(args) -> int:
+  from kf_benchmarks_tpu.analysis import lint
+  return lint.main(["--rules", args.rules] if args.rules else [])
+
+
+def run_audit(args) -> int:
+  _force_virtual_cpu_mesh()
+  from kf_benchmarks_tpu.analysis import audit, baseline, contracts
+
+  names = (args.configs.split(",") if args.configs
+           else list(contracts.GOLDEN_CONFIGS))
+  unknown = [n for n in names if n not in contracts.GOLDEN_CONFIGS]
+  if unknown:
+    print(f"unknown golden config(s): {unknown}; have "
+          f"{list(contracts.GOLDEN_CONFIGS)}")
+    return 2
+
+  configs = {n: contracts.GOLDEN_CONFIGS[n] for n in names}
+  tracer = audit.make_memo_tracer()
+  report = audit.audit_configs(configs, tracer=tracer)
+
+  diff_total = 0
+  for name in names:
+    contract = tracer(configs[name], "train_step")
+    if args.write_goldens:
+      path = baseline.write_golden(name, contract)
+      print(f"golden written: {path}")
+      continue
+    diffs = baseline.check_against_golden(name, contract)
+    report["configs"][name]["golden_diffs"] = [
+        {"field": f, "golden": g, "current": c} for f, g, c in diffs]
+    diff_total += len(diffs)
+    for f, g, c in diffs:
+      print(f"GOLDEN DIFF [{name}] {f}: golden={g!r} current={c!r}")
+
+  for name, entry in report["configs"].items():
+    for v in entry["violations"]:
+      print(f"CONTRACT VIOLATION [{name}] [{v['rule']}] {v['message']}")
+    status = ("OK" if not entry["violations"]
+              and not entry.get("golden_diffs") else "FAIL")
+    print(f"audit [{name}]: {status} ({entry['collectives']} collectives, "
+          f"{entry['gradient_collectives']} gradient, "
+          f"{entry['in_loop_collectives']} in-loop)")
+
+  if args.json:
+    with open(args.json, "w", encoding="utf-8") as f:
+      json.dump(report, f, indent=2, sort_keys=True)
+    print(f"report written: {args.json}")
+  print(f"program-contract audit: {report['violations']} violation(s), "
+        f"{diff_total} golden diff(s) across {len(names)} config(s)")
+  if args.write_goldens:
+    return 1 if report["violations"] else 0
+  return 1 if (report["violations"] or diff_total) else 0
+
+
+def main(argv=None) -> int:
+  parser = argparse.ArgumentParser(
+      prog="python -m kf_benchmarks_tpu.analysis", description=__doc__)
+  parser.add_argument("mode", nargs="?", default="all",
+                      choices=("all", "lint", "audit"))
+  parser.add_argument("--configs", default=None,
+                      help="comma-separated golden-config names "
+                           "(default: all)")
+  parser.add_argument("--rules", default=None,
+                      help="comma-separated lint rule ids (default: all)")
+  parser.add_argument("--json", default=None,
+                      help="write the audit report as JSON to this path")
+  parser.add_argument("--write-goldens", action="store_true",
+                      help="(re)generate tests/golden_contracts/*.json "
+                           "from the current tree instead of diffing")
+  args = parser.parse_args(argv)
+  rc = 0
+  if args.mode in ("all", "lint"):
+    rc |= run_lint(args)
+  if args.mode in ("all", "audit"):
+    rc |= run_audit(args)
+  return rc
+
+
+if __name__ == "__main__":
+  sys.exit(main())

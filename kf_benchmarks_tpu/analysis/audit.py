@@ -1,0 +1,290 @@
+"""Rule engine: check every earned program contract against a config.
+
+Each rule encodes one guarantee a past PR earned and a test pinned for
+the configs it happened to cover; here the same invariant is checked
+for ANY config (the golden lattice in ``contracts.GOLDEN_CONFIGS``, or
+whatever the CLI is pointed at), the way the reference leaned on
+graph-mode structure checks before a session ever ran (SURVEY 2).
+
+A rule is (id, applies(config) -> bool, check(contract, tracer) ->
+[message]); ``audit_contract`` runs every applicable rule and returns
+machine-readable violations. ``tracer`` lets paired rules trace a twin
+config (health on vs off) through the same memoized path.
+
+Mutation self-tests (tests/test_program_audit.py) seed violations --
+an extra in-loop psum, a leaked f32 wire, a materialized (B, T, V)
+buffer -- and assert exactly the intended rule fires, so this engine
+cannot rot into a pass-everything stub.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+from kf_benchmarks_tpu.analysis.contracts import ProgramContract
+
+
+@dataclasses.dataclass
+class Violation:
+  rule: str
+  message: str
+
+  def as_dict(self):
+    return {"rule": self.rule, "message": self.message}
+
+
+def _cfg(contract: ProgramContract, name: str, default=None):
+  return contract.config.get(name, default)
+
+
+def _accum(contract) -> int:
+  return int(_cfg(contract, "num_grad_accum", 1) or 1)
+
+
+def _overlap(contract) -> bool:
+  return bool(_cfg(contract, "overlap_gradient_reduction", False))
+
+
+def _replicated_sync(contract) -> bool:
+  vu = _cfg(contract, "variable_update", "replicated")
+  sync = bool(_cfg(contract, "cross_replica_sync", True))
+  return vu in ("replicated", "distributed_replicated", "parameter_server",
+                "collective_all_reduce", "distributed_all_reduce") and sync
+
+
+# -- the earned contracts -----------------------------------------------------
+
+def rule_accum_one_collective(contract, tracer):
+  """PR 2: --num_grad_accum pays ONE gradient reduction per step, never
+  inside the microbatch scan; with a packing reducer the count is
+  literally one."""
+  if _accum(contract) <= 1:
+    return []
+  out = []
+  grads = contract.gradient_collectives()
+  in_loop = [c for c in grads if c.in_loop]
+  if in_loop:
+    out.append(f"{len(in_loop)} gradient collective(s) inside the "
+               "microbatch scan body -- reduction must be per STEP, "
+               "not per microbatch")
+  packed = (int(_cfg(contract, "agg_small_grads_max_bytes", 0) or 0) > 0
+            or int(_cfg(contract, "gradient_repacking", 0) or 0) > 0)
+  if packed and len(grads) != 1:
+    out.append(f"expected exactly ONE packed gradient all-reduce per "
+               f"accumulated step, found {len(grads)}")
+  return out
+
+
+def rule_overlap_in_backward(contract, tracer):
+  """PR 3: in-backward collectives iff --overlap_gradient_reduction.
+
+  Overlap ON with a scanned-layers model: the per-block collective must
+  sit INSIDE the backward scan's while body. Overlap OFF (or hooks
+  disengaged under --num_grad_accum): NO collective may be in-loop."""
+  engaged = _overlap(contract) and _accum(contract) == 1
+  in_loop = contract.in_loop_collectives()
+  if not engaged:
+    if not _replicated_sync(contract):
+      # async-PS sequential apply / gossip schedules legitimately issue
+      # collectives inside scans; the iff only binds the replicated
+      # family the overlap mode is defined for.
+      return []
+    if _accum(contract) > 1:
+      # The microbatch scan is rule_accum_one_collective's territory
+      # (one owner per seeded violation, so mutation self-tests can
+      # assert exactly one rule fires).
+      return []
+    if in_loop:
+      return [f"{len(in_loop)} collective(s) inside a scanned body with "
+              "the in-backward hooks off -- a collective leaked into a "
+              "while loop"]
+    return []
+  out = []
+  if contract.aux.get("overlap_module_prefixes"):
+    if not in_loop:
+      out.append("overlap engaged on a scanned-layers model but no "
+                 "collective sits inside the backward scan body")
+  expected = contract.aux.get("overlap_step_buckets")
+  if expected is not None:
+    step_grads = [c for c in contract.gradient_collectives()
+                  if not c.in_loop]
+    if len(step_grads) != expected:
+      out.append(f"step-level gradient collectives {len(step_grads)} != "
+                 f"planned bucket count {expected}")
+  return out
+
+
+def rule_no_btv_buffer(contract, tracer):
+  """PR 2: the fused-head scanned LM materializes no (B, T, V) logits
+  tensor anywhere in the compiled step."""
+  btv = contract.aux.get("btv_bytes")
+  if btv is None:
+    return []
+  if contract.largest_tensor_bytes >= btv:
+    return [f"largest program buffer {contract.largest_tensor_type} "
+            f"({contract.largest_tensor_bytes} B) >= the (B, T, V) "
+            f"logits tensor ({btv} B) the fused head exists to avoid"]
+  return []
+
+
+def rule_health_no_extra_collective(contract, tracer):
+  """PR 4: the health-on step carries NO additional collective (the
+  stats ride the loss pmean)."""
+  if not contract.aux.get("health_stats"):
+    return []
+  if tracer is None:
+    return []
+  twin_cfg = dict(contract.config)
+  twin_cfg["health_stats"] = False
+  twin = tracer(twin_cfg, contract.program)
+  n_on = sum(1 for c in contract.collectives if c.kind == "all-reduce")
+  n_off = sum(1 for c in twin.collectives if c.kind == "all-reduce")
+  if n_on > n_off:
+    return [f"health stats added collectives: {n_on} all-reduces vs "
+            f"{n_off} with stats off"]
+  return []
+
+
+def rule_wire_dtype(contract, tracer):
+  """PR 3 satellite: gradients ride a bf16 wire iff the compact
+  transfer engages (--use_fp16, or --compact_gradient_transfer_f32 on
+  a packed path); pure-f32 training keeps an f32 wire."""
+  grads = contract.gradient_collectives()
+  if not grads:
+    return []
+  compact_16 = bool(_cfg(contract, "compact_gradient_transfer_f32")
+                    or _cfg(contract, "use_fp16"))
+  # The lowered-level wire (what the program REQUESTS -- the TPU wire)
+  # when the tracer recorded it; the compiled dump's dtypes otherwise
+  # (XLA:CPU legalizes 16-bit collectives to f32 while compiling).
+  requested = contract.aux.get("requested_grad_wires")
+  wire = set(requested) if requested else {c.dtype for c in grads}
+  if compact_16 and "f32" in wire:
+    return [f"16-bit wire expected but f32 gradient all-reduce(s) "
+            f"found (wire dtypes: {sorted(wire)})"]
+  if not compact_16 and wire != {"f32"}:
+    return [f"f32 wire expected (no 16-bit compaction engaged) but "
+            f"found wire dtypes {sorted(wire)}"]
+  return []
+
+
+# -- program-shape invariants (every config) ----------------------------------
+
+def rule_no_host_transfer(contract, tracer):
+  """The step program must stay device-resident: any infeed/outfeed/
+  send/recv would put a host round-trip (~70 ms tunnel RTT) in the
+  step."""
+  if contract.host_transfers:
+    return [f"host-transfer ops in the step program: "
+            f"{contract.host_transfers}"]
+  return []
+
+
+def rule_state_donated(contract, tracer):
+  """TrainState is donated (donate_argnums=(0,)): losing the aliasing
+  doubles the state's HBM footprint."""
+  if contract.donated_buffers == 0:
+    return ["no input/output buffer aliasing -- the donated TrainState "
+            "stopped aliasing (HBM footprint doubles)"]
+  return []
+
+
+def rule_single_optimizer_apply(contract, tracer):
+  """Exactly one optimizer apply per step, outside every scan (async-PS
+  sequential_apply is the documented exception and is excluded)."""
+  vu = _cfg(contract, "variable_update", "replicated")
+  if vu == "parameter_server" and not _cfg(contract, "cross_replica_sync",
+                                           True):
+    return []
+  if contract.program != "train_step":
+    return []  # the chunked program scans the WHOLE step by design
+  out = []
+  if not contract.optimizer_apply_present:
+    out.append("optimizer_apply scope missing from the step program "
+               "(train_step.py's named_scope)")
+  elif contract.optimizer_apply_in_loop:
+    out.append("optimizer apply inside a scanned body -- the update "
+               "must run once per step, after any microbatch scan")
+  return out
+
+
+def rule_full_mesh_replica_groups(contract, tracer):
+  """Replicated-family reductions span the full replica mesh as one
+  group -- a split group means a silent partial reduction."""
+  if not _replicated_sync(contract):
+    return []
+  n = contract.aux.get("num_devices")
+  if not n:
+    return []
+  want = "{{" + ",".join(str(i) for i in range(n)) + "}}"
+  bad = [c for c in contract.collectives
+         if c.kind == "all-reduce" and c.replica_groups
+         and c.replica_groups != want]
+  if bad:
+    return [f"{len(bad)} all-reduce(s) with partial replica groups "
+            f"(want {want}, got e.g. {bad[0].replica_groups})"]
+  return []
+
+
+RULES: Dict[str, Callable] = {
+    "accum-one-collective": rule_accum_one_collective,
+    "overlap-in-backward": rule_overlap_in_backward,
+    "no-btv-buffer": rule_no_btv_buffer,
+    "health-no-extra-collective": rule_health_no_extra_collective,
+    "wire-dtype": rule_wire_dtype,
+    "no-host-transfer": rule_no_host_transfer,
+    "state-donated": rule_state_donated,
+    "single-optimizer-apply": rule_single_optimizer_apply,
+    "full-mesh-replica-groups": rule_full_mesh_replica_groups,
+}
+
+
+def audit_contract(contract: ProgramContract,
+                   tracer: Optional[Callable] = None,
+                   rules: Optional[Dict[str, Callable]] = None
+                   ) -> List[Violation]:
+  """Run every rule over one contract; return machine-readable
+  violations. ``tracer(overrides, program) -> ProgramContract`` serves
+  the paired rules (health twin); None skips them."""
+  out = []
+  for rule_id, rule in (rules or RULES).items():
+    for msg in rule(contract, tracer):
+      out.append(Violation(rule=rule_id, message=msg))
+  return out
+
+
+def make_memo_tracer() -> Callable:
+  """A memoizing ``tracer(overrides, program) -> ProgramContract`` so a
+  config traced for the audit is not re-compiled for the golden diff
+  (or for a paired rule's twin)."""
+  from kf_benchmarks_tpu.analysis import contracts as contracts_lib
+  memo: Dict[str, ProgramContract] = {}
+
+  def tracer(overrides, program="train_step"):
+    key = repr(sorted(overrides.items())) + program
+    if key not in memo:
+      memo[key] = contracts_lib.trace_contract(dict(overrides), program)
+    return memo[key]
+
+  return tracer
+
+
+def audit_configs(configs: Dict[str, Dict[str, Any]],
+                  tracer: Optional[Callable] = None) -> Dict[str, Any]:
+  """Trace + audit each named config; returns the machine-readable
+  report the CLI emits as JSON."""
+  tracer = tracer or make_memo_tracer()
+  report = {"configs": {}, "violations": 0}
+  for name, overrides in configs.items():
+    contract = tracer(dict(overrides), "train_step")
+    violations = audit_contract(contract, tracer)
+    report["configs"][name] = {
+        "config": dict(overrides),
+        "violations": [v.as_dict() for v in violations],
+        "collectives": len(contract.collectives),
+        "in_loop_collectives": len(contract.in_loop_collectives()),
+        "gradient_collectives": len(contract.gradient_collectives()),
+    }
+    report["violations"] += len(violations)
+  return report

@@ -1,0 +1,109 @@
+"""Golden program contracts: serialize, load, diff.
+
+``tests/golden_contracts/<name>.json`` pins the STRUCTURAL contract of
+each golden config (collective inventory by kind/dtype/placement,
+donation, optimizer-apply scope, host transfers) so a regression --
+a duplicated pmean, a dtype drift, a collective sliding out of the
+backward loop -- fails with a field-level diff instead of a silent
+perf cliff on the serialized TPU chip.
+
+Volatile statics (buffer sizes, custom-call targets, temp totals) stay
+OUT of the goldens: they move with the XLA version, and the memory
+contracts are enforced as rules (audit.rule_no_btv_buffer) against
+bounds derived from the config, not pinned bytes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from typing import Any, Dict, List, Tuple
+
+from kf_benchmarks_tpu.analysis.contracts import ProgramContract
+
+GOLDEN_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "tests", "golden_contracts")
+
+
+def contract_fingerprint(contract: ProgramContract) -> Dict[str, Any]:
+  """The stable, golden-worthy subset of a contract."""
+  inventory = Counter(
+      (c.kind, c.dtype, "scalar" if c.scalar else "tensor",
+       "in_loop" if c.in_loop else "top_level")
+      for c in contract.collectives)
+  return {
+      "config": dict(contract.config),
+      "program": contract.program,
+      "collectives": _sorted_collectives(
+          {"kind": k, "dtype": d, "rank": r, "placement": p, "count": n}
+          for (k, d, r, p), n in inventory.items()),
+      "gradient_collectives": len(contract.gradient_collectives()),
+      "in_loop_collectives": len(contract.in_loop_collectives()),
+      "host_transfers": list(contract.host_transfers),
+      "optimizer_apply_present": contract.optimizer_apply_present,
+      "optimizer_apply_in_loop": contract.optimizer_apply_in_loop,
+      "state_donated": contract.donated_buffers > 0,
+      # Lowered-level gradient wire dtypes (the TPU wire; see
+      # contracts.requested_all_reduce_wires).
+      "requested_grad_wires": contract.aux.get("requested_grad_wires"),
+  }
+
+
+def _sorted_collectives(entries):
+  return sorted(entries, key=lambda e: json.dumps(e, sort_keys=True))
+
+
+def diff_fingerprints(golden: Dict[str, Any], current: Dict[str, Any]
+                      ) -> List[Tuple[str, Any, Any]]:
+  """Field-level diff: [(field, golden_value, current_value), ...].
+
+  Collective inventories diff per-entry so the report names the exact
+  (kind, dtype, placement) row that changed count."""
+  diffs = []
+  keys = sorted(set(golden) | set(current))
+  for key in keys:
+    g, c = golden.get(key), current.get(key)
+    if key == "collectives":
+      g_rows = {json.dumps({k: v for k, v in e.items() if k != "count"},
+                           sort_keys=True): e.get("count")
+                for e in (g or [])}
+      c_rows = {json.dumps({k: v for k, v in e.items() if k != "count"},
+                           sort_keys=True): e.get("count")
+                for e in (c or [])}
+      for row in sorted(set(g_rows) | set(c_rows)):
+        if g_rows.get(row) != c_rows.get(row):
+          diffs.append((f"collectives[{row}].count",
+                        g_rows.get(row), c_rows.get(row)))
+    elif g != c:
+      diffs.append((key, g, c))
+  return diffs
+
+
+def golden_path(name: str) -> str:
+  return os.path.join(GOLDEN_DIR, f"{name}.json")
+
+
+def load_golden(name: str) -> Dict[str, Any]:
+  with open(golden_path(name), encoding="utf-8") as f:
+    return json.load(f)
+
+
+def write_golden(name: str, contract: ProgramContract) -> str:
+  os.makedirs(GOLDEN_DIR, exist_ok=True)
+  path = golden_path(name)
+  with open(path, "w", encoding="utf-8") as f:
+    json.dump(contract_fingerprint(contract), f, indent=2, sort_keys=True)
+    f.write("\n")
+  return path
+
+
+def check_against_golden(name: str, contract: ProgramContract
+                         ) -> List[Tuple[str, Any, Any]]:
+  """Diff a traced contract against its checked-in golden; a missing
+  golden is itself a (whole-file) diff."""
+  path = golden_path(name)
+  if not os.path.exists(path):
+    return [("<golden file>", "missing", path)]
+  return diff_fingerprints(load_golden(name), contract_fingerprint(contract))

@@ -1,0 +1,321 @@
+"""Program-contract extraction: trace a config's train step, never run it.
+
+The single source of the HLO-scraping conventions the test suite pins
+against (previously triplicated across tests/test_overlap_reduction.py,
+tests/test_grad_accum.py and tests/test_telemetry.py):
+
+* an *all-reduce definition* is an instruction-definition line matching
+  :data:`ALL_REDUCE_DEF` (``-start`` covers async pairs);
+* a collective is *in the backward loop* when its jax ``op_name``
+  metadata places it inside a scanned (``while``) body -- the backward
+  of a lax.scan/nn.scan lowers to a while loop, and a collective issued
+  by an in-backward hook carries the loop in its op_name;
+* *gradient traffic* is the non-scalar all-reduce
+  (:data:`GRAD_MIN_ELEMS` guards the packed health/metric vectors);
+  ``f32[]`` reductions are the step's metric pmeans.
+
+On top of the shared helpers, :func:`trace_contract` builds a config's
+step program exactly as the runtime does (``BenchmarkCNN._build``),
+lowers it over the abstract 8-device mesh with ``jax.eval_shape`` +
+``jit(...).lower(...)`` -- no train step ever executes, only XLA
+compilation runs -- and extracts a :class:`ProgramContract` that
+``audit`` checks and ``baseline`` diffs against goldens.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+# -- shared HLO-scraping helpers (the tests import these) ---------------------
+
+ALL_REDUCE_DEF = re.compile(r"=\s+\S+\s+all-reduce(-start)?\(")
+
+# A non-scalar all-reduce below this element count is a packed
+# metric/health vector (telemetry packs ~10 floats onto the loss pmean),
+# not gradient traffic; every real gradient bucket is far larger.
+GRAD_MIN_ELEMS = 128
+
+
+def all_reduce_defs(hlo: str) -> List[str]:
+  """All-reduce instruction definition lines of a compiled-HLO dump."""
+  return [ln for ln in hlo.splitlines() if ALL_REDUCE_DEF.search(ln)]
+
+
+def in_backward_loop(defs) -> List[str]:
+  """Defs whose jax op_name places them inside a scanned (while) body --
+  the in-backward position the overlap hooks pin."""
+  return [ln for ln in defs if "while" in ln]
+
+
+_SCALAR_ALL_REDUCE = re.compile(r"=\s+\w+\[\]\s+all-reduce")
+
+
+def grad_all_reduce_defs(hlo: str):
+  """(all defs, gradient defs): gradient traffic is the non-scalar
+  all-reduce; ``f32[]`` reductions are the step's metric pmeans.
+
+  Intentionally LOOSER than :meth:`Collective.is_gradient_traffic`:
+  no :data:`GRAD_MIN_ELEMS` floor, because the test pins that import
+  this helper drive tiny toy models whose real gradient buckets can be
+  under the floor, and their programs carry no packed health vector to
+  exclude. The auditor's real-config predicate needs the floor; keep
+  the two in mind if a pin ever mixes health stats with this helper."""
+  defs = all_reduce_defs(hlo)
+  grad = [ln for ln in defs if not _SCALAR_ALL_REDUCE.search(ln)]
+  return defs, grad
+
+
+# -- structured contract ------------------------------------------------------
+
+_COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                     "collective-permute", "all-to-all")
+_COLLECTIVE_DEF = re.compile(
+    r"=\s+(?P<type>[^\s].*?)\s+"
+    r"(?P<kind>" + "|".join(_COLLECTIVE_KINDS) + r")(?P<start>-start)?\(")
+_ARRAY_TYPE = re.compile(
+    r"\b(pred|bf16|f16|f32|f64|s8|s16|s32|s64|u8|u16|u32|u64|c64|c128)"
+    r"\[([0-9,]*)\]")
+_REPLICA_GROUPS = re.compile(r"replica_groups=(\{\{[0-9, ]*(?:\},\{[0-9, ]*)*\}\})")
+_CUSTOM_CALL_TARGET = re.compile(r'custom_call_target="([^"]+)"')
+_ALIAS_ENTRY = re.compile(r"(?:may|must)-alias")
+_HOST_TRANSFER_KINDS = ("infeed", "outfeed", " send(", " recv(",
+                        "send-done", "recv-done")
+
+_ITEMSIZE = {"pred": 1, "s8": 1, "u8": 1, "bf16": 2, "f16": 2, "s16": 2,
+             "u16": 2, "f32": 4, "s32": 4, "u32": 4, "c64": 8, "f64": 8,
+             "s64": 8, "u64": 8, "c128": 16}
+
+# A stablehlo.all_reduce result type in LOWERED (pre-optimization) text:
+# "... }) : (tensor<4101097xbf16>) -> tensor<4101097xbf16>". The wire
+# dtype must be read here: XLA:CPU legalizes 16-bit collectives to f32
+# during compilation, so the COMPILED dump shows the backend's wire,
+# not the program's requested one (which is what the TPU runs).
+_STABLEHLO_ALL_REDUCE = re.compile(
+    r'"stablehlo\.all_reduce".*?-> tensor<([0-9a-z_]+)>', re.S)
+
+
+def requested_all_reduce_wires(lowered_text: str):
+  """[(dtype, elems), ...] of every all_reduce in a lowered module."""
+  out = []
+  for spec in _STABLEHLO_ALL_REDUCE.findall(lowered_text):
+    parts = spec.split("x")
+    dtype = parts[-1]
+    elems = math.prod(int(d) for d in parts[:-1]) if len(parts) > 1 else 1
+    out.append((dtype, elems))
+  return out
+
+
+def _array_bytes(dtype: str, dims: str) -> int:
+  elems = math.prod(int(d) for d in dims.split(",") if d) if dims else 1
+  return elems * _ITEMSIZE[dtype]
+
+
+@dataclasses.dataclass
+class Collective:
+  """One collective instruction of the compiled step program."""
+  kind: str            # all-reduce | all-gather | reduce-scatter | ...
+  dtype: str           # wire dtype of the (first) array operand
+  elems: int           # element count (1 for scalars)
+  scalar: bool
+  in_loop: bool        # inside a scanned (while) body
+  replica_groups: str  # "" when the kind has none (collective-permute)
+
+  def is_gradient_traffic(self) -> bool:
+    return (self.kind == "all-reduce" and not self.scalar
+            and self.elems >= GRAD_MIN_ELEMS)
+
+
+@dataclasses.dataclass
+class ProgramContract:
+  """Structured statics of one compiled step program."""
+  config: Dict[str, Any]          # the param overrides that produced it
+  program: str                    # "train_step" | "train_chunk"
+  collectives: List[Collective]
+  host_transfers: List[str]       # infeed/outfeed/send/recv kinds found
+  custom_call_targets: List[str]  # informational (backend-dependent)
+  optimizer_apply_present: bool   # train_step.py's named_scope found
+  optimizer_apply_in_loop: bool   # ... inside a while body
+  donated_buffers: int            # input_output_alias entry count
+  largest_tensor_bytes: int       # biggest single array in the program
+  largest_tensor_type: str        # e.g. "f32[4096,1001]"
+  temp_bytes: Optional[int]       # memory_analysis().temp_size_in_bytes
+  aux: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+  def gradient_collectives(self) -> List[Collective]:
+    return [c for c in self.collectives if c.is_gradient_traffic()]
+
+  def in_loop_collectives(self) -> List[Collective]:
+    return [c for c in self.collectives if c.in_loop]
+
+
+def extract_contract(hlo: str, config: Optional[dict] = None,
+                     program: str = "train_step",
+                     temp_bytes: Optional[int] = None,
+                     aux: Optional[dict] = None) -> ProgramContract:
+  """Parse a compiled-HLO text dump into a :class:`ProgramContract`.
+
+  Pure text analysis (no jax): tests feed hand-built programs through
+  this to seed violations the audit rules must catch.
+  """
+  collectives = []
+  host_transfers = []
+  for ln in hlo.splitlines():
+    m = _COLLECTIVE_DEF.search(ln)
+    if m:
+      arr = _ARRAY_TYPE.search(m.group("type"))
+      dtype, dims = (arr.group(1), arr.group(2)) if arr else ("f32", "")
+      elems = (math.prod(int(d) for d in dims.split(",") if d)
+               if dims else 1)
+      groups = _REPLICA_GROUPS.search(ln)
+      collectives.append(Collective(
+          kind=m.group("kind"), dtype=dtype, elems=elems,
+          scalar=not dims, in_loop="while" in ln,
+          replica_groups=groups.group(1).replace(" ", "") if groups
+          else ""))
+    # Only the instruction text counts (op_name metadata may quote a
+    # jax scope containing e.g. 'send' without the op being one).
+    head = ln.split("metadata")[0]
+    for kind in _HOST_TRANSFER_KINDS:
+      if kind in head and "=" in head:
+        host_transfers.append(kind.strip().strip("("))
+  opt_lines = [ln for ln in hlo.splitlines() if "optimizer_apply" in ln]
+  largest_bytes, largest_type = 0, ""
+  for dtype, dims in _ARRAY_TYPE.findall(hlo):
+    b = _array_bytes(dtype, dims)
+    if b > largest_bytes:
+      largest_bytes, largest_type = b, f"{dtype}[{dims}]"
+  return ProgramContract(
+      config=dict(config or {}), program=program,
+      collectives=collectives, host_transfers=sorted(set(host_transfers)),
+      custom_call_targets=sorted(set(_CUSTOM_CALL_TARGET.findall(hlo))),
+      optimizer_apply_present=bool(opt_lines),
+      optimizer_apply_in_loop=any("while" in ln for ln in opt_lines),
+      donated_buffers=len(_ALIAS_ENTRY.findall(hlo)),
+      largest_tensor_bytes=largest_bytes, largest_tensor_type=largest_type,
+      temp_bytes=temp_bytes, aux=dict(aux or {}))
+
+
+# -- config -> contract (trace, never execute) --------------------------------
+
+N_REPLICAS = 8  # the abstract mesh every golden traces on (conftest's)
+
+
+def trace_contract(overrides: Dict[str, Any],
+                   program: str = "train_step") -> ProgramContract:
+  """Build + lower + compile the step program for ``overrides``; extract.
+
+  Mirrors the runtime exactly (``BenchmarkCNN._build``), but the state
+  is ``jax.eval_shape``-abstract and inputs are ``ShapeDtypeStruct``s:
+  nothing executes, only XLA compilation runs. Requires the 8-device
+  CPU mesh (tests get it from conftest; the CLI sets XLA_FLAGS).
+  """
+  import jax
+  import jax.numpy as jnp
+  from kf_benchmarks_tpu import benchmark
+  from kf_benchmarks_tpu import params as params_lib
+  from kf_benchmarks_tpu.ops import overlap as overlap_lib
+
+  kw = dict(device="cpu", num_devices=N_REPLICAS, num_batches=2)
+  kw.update(overrides)
+  p = params_lib.make_params(**kw)
+  bench = benchmark.BenchmarkCNN(p)
+  fns = bench._build()
+  init_state, train_step, train_chunk = fns[0], fns[1], fns[4]
+  in_shapes = bench.model.get_input_shapes("train")
+  in_dtypes = bench.model.get_input_data_types("train")
+  sample = jax.ShapeDtypeStruct(tuple(in_shapes[0]), in_dtypes[0])
+  state_sds = jax.eval_shape(init_state, jax.random.PRNGKey(0), sample)
+  n = bench.num_devices
+  gx = jax.ShapeDtypeStruct((in_shapes[0][0] * n,) + tuple(in_shapes[0][1:]),
+                            in_dtypes[0])
+  gy = jax.ShapeDtypeStruct((in_shapes[1][0] * n,) + tuple(in_shapes[1][1:]),
+                            in_dtypes[1])
+  if program == "train_chunk":
+    if train_chunk is None:
+      raise ValueError("train_chunk requested but --steps_per_dispatch=1")
+    # Synthetic resident chunk: leading staged-steps axis of 1.
+    gx = jax.ShapeDtypeStruct((1,) + gx.shape, gx.dtype)
+    gy = jax.ShapeDtypeStruct((1,) + gy.shape, gy.dtype)
+    lowered = train_chunk.lower(state_sds, gx, gy)
+  else:
+    lowered = train_step.lower(state_sds, gx, gy)
+  compiled = lowered.compile()
+
+  aux: Dict[str, Any] = {
+      "model": bench.model.get_name(),
+      "num_devices": n,
+      "per_device_batch": int(in_shapes[0][0]),
+      "health_stats": bool(bench.params.health_stats),
+      # Gradient wire dtypes the PROGRAM requests (lowered level; the
+      # compiled CPU dump legalizes 16-bit collectives to f32).
+      "requested_grad_wires": sorted({
+          dtype for dtype, elems in requested_all_reduce_wires(
+              lowered.as_text())
+          if elems >= GRAD_MIN_ELEMS}),
+  }
+  # The (B, T, V) bound the fused-head LM contract is checked against:
+  # the bytes of the logits tensor the program must NOT materialize.
+  if bench.model.get_name() == "transformer_lm":
+    from kf_benchmarks_tpu.models import transformer_lm as lm
+    itemsize = jnp.dtype(bench.compute_dtype).itemsize
+    aux["btv_bytes"] = int(in_shapes[0][0]) * lm.SEQ_LEN * lm.VOCAB * itemsize
+  # Expected step-level bucket count when the overlap hooks engage
+  # (module-reduced prefixes are excluded -- their reduction is the
+  # in-loop per-block collective).
+  spec = overlap_lib.build(p)
+  if spec is not None and int(p.num_grad_accum or 1) == 1:
+    import types
+    params_tree = jax.tree.map(
+        lambda s: types.SimpleNamespace(
+            size=math.prod(s.shape[1:]), dtype=s.dtype),
+        state_sds.params)
+    module_prefixes = tuple(
+        getattr(bench.model, "in_backward_reduced_prefixes", ()) or ())
+    buckets, _ = overlap_lib.plan_buckets(
+        params_tree, spec.bucket_bytes, exclude_prefixes=module_prefixes)
+    aux["overlap_step_buckets"] = len(buckets)
+    aux["overlap_module_prefixes"] = list(module_prefixes)
+
+  temp = None
+  try:
+    temp = int(compiled.memory_analysis().temp_size_in_bytes)
+  except Exception:  # backend without memory analysis
+    temp = None
+  return extract_contract(compiled.as_text(), config=dict(overrides),
+                          program=program, temp_bytes=temp, aux=aux)
+
+
+# -- the golden lattice -------------------------------------------------------
+
+# Every earned program-level contract, sampled across the flag lattice.
+# Keys are the golden names (tests/golden_contracts/<name>.json); values
+# are make_params overrides on top of the cpu/8-device/trivial defaults.
+GOLDEN_CONFIGS: "OrderedDict[str, Dict[str, Any]]" = OrderedDict([
+    # The monolithic default program (the PERF.md envelope).
+    ("base", dict(model="trivial", batch_size=4)),
+    # PR 2: --num_grad_accum pays ONE packed gradient collective per
+    # step, outside the microbatch scan (agg packing makes "one"
+    # literal, as in tests/test_grad_accum.py).
+    ("accum4_packed", dict(model="trivial", batch_size=4, num_grad_accum=4,
+                           agg_small_grads_max_bytes=1 << 30,
+                           agg_small_grads_max_group=1000)),
+    # PR 3: bucketed in-backward reduction, step-level hooks.
+    ("overlap", dict(model="trivial", batch_size=4,
+                     overlap_gradient_reduction=True)),
+    # PR 3 satellite: the f32-training bf16 wire opt-in.
+    ("overlap_bf16_wire", dict(model="trivial", batch_size=4,
+                               overlap_gradient_reduction=True,
+                               compact_gradient_transfer_f32=True)),
+    # PR 4: in-step health stats ride the loss pmean (no new collective).
+    ("health", dict(model="trivial", batch_size=4, health_stats=True)),
+    # PR 2: the scanned fused-head LM never materializes (B, T, V).
+    ("lm_base", dict(model="transformer_lm", batch_size=8)),
+    # PR 3: the scanned LM's per-block collective lands INSIDE the
+    # backward scan's while body.
+    ("lm_overlap", dict(model="transformer_lm", batch_size=8,
+                        overlap_gradient_reduction=True)),
+])

@@ -1,0 +1,526 @@
+"""Repo-wide hazard lint: CLAUDE.md's hard-won rules as an AST pass.
+
+Each rule encodes an operational hazard this environment taught the
+hard way (a wedged TPU tunnel, a lying sync primitive, a silently
+unvalidated flag) -- see CLAUDE.md's TPU-environment-hazards section.
+Pure stdlib: this file imports nothing beyond the standard library, so
+loaded by path (as ``run_tests.py --audit`` does) the lint runs in any
+interpreter in ~a second -- note that importing it as
+``kf_benchmarks_tpu.analysis.lint`` pulls the package ``__init__``,
+which imports jax.
+
+Rules (ids):
+
+* ``block-until-ready`` -- ``jax.block_until_ready`` returns before
+  device execution completes on the tunneled backend; every sync must
+  go through ``utils.sync.drain``. Banned outside ``utils/sync.py``.
+* ``version-gate-comment`` -- jax version gates (``hasattr(jax.lax,
+  "pcast")``-style probes, ``jax.__version__`` comparisons) require a
+  nearby comment/docstring naming the missing API, so a gate can be
+  retired when the API lands (CLAUDE.md: "Add no new version gates
+  without a comment naming the missing API").
+* ``kill-timeout`` -- a kill-based ``timeout=`` on a subprocess that
+  talks to the TPU is the wedge trigger (a client killed mid-claim
+  wedges ``jax.devices()`` for hours; round-4 incident). Banned in
+  tests around TPU-bound subprocesses.
+* ``step-line-format`` -- the reference step-line format literal is
+  single-sourced in ``utils/log.py`` (tests scrape stdout; a drifted
+  second copy would print lines the scrapers half-match).
+* ``flag-validation`` -- every flag in the params registry either
+  appears in ``validation.py`` or carries an explicit entry in its
+  ``NO_CROSS_FLAG_VALIDATION`` marker (with a reason); a flag that is
+  both is a stale marker.
+* ``citation`` -- every top-level module (and subpackage) cites the
+  reference ``file:line`` span it covers, with a reasoned allowlist
+  for TPU-native-only modules (folded in from the former standalone
+  citation lint; tests/test_citation_lint.py pins it).
+
+Every allowlist entry is checked for staleness: an entry whose file no
+longer trips the rule must be removed, so allowlists cannot rot into
+blanket exemptions.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from typing import Dict, List, NamedTuple, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_SCAN_DIRS = ("kf_benchmarks_tpu", "tests", "experiments")
+_SKIP_PARTS = {"__pycache__", ".git", "native"}
+
+
+class LintViolation(NamedTuple):
+  rule: str
+  path: str    # repo-relative, forward slashes
+  line: int
+  message: str
+
+  def render(self) -> str:
+    return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+# -- allowlists (every entry carries its reason; staleness-checked) ----------
+
+BLOCK_UNTIL_READY_ALLOWLIST = {
+    "experiments/gossip_hier_scale_probe.py":
+        "CPU-mesh probe (build_mesh(n, 'cpu')): block_until_ready is "
+        "trustworthy on the host platform; the lie is tunnel-specific",
+    "experiments/pallas_conv_probe.py":
+        "round-2 probe predating the drain discovery; kept verbatim as "
+        "the committed measurement artifact behind PERF.md round 2 "
+        "(superseded methodology documented in "
+        "experiments/pallas_fused_chain_probe.py)",
+}
+
+VERSION_GATE_ALLOWLIST = {
+    "kf_benchmarks_tpu/compat.py":
+        "the version bridge itself: its module docstring names every "
+        "shimmed API (jax.shard_map, check_vma/check_rep, lax.axis_size)",
+    "tests/test_allreduce.py":
+        "pre-vma skip marker: the reason names the missing CPU gloo "
+        "cross-host path rather than the gate attr (CLAUDE.md lists it)",
+    "tests/test_transformer_scan_remat.py":
+        "pre-vma skip marker: composed-program oracle gap "
+        "(compat.py check_rep note; CLAUDE.md lists it)",
+    "tests/test_tensor_parallel.py":
+        "pre-vma skip marker: the Megatron 1-collective HLO assertion "
+        "holds on current jax only (CLAUDE.md lists it)",
+}
+
+KILL_TIMEOUT_ALLOWLIST: Dict[str, str] = {}
+
+# Citation allowlist (moved here from tests/test_citation_lint.py):
+# TPU-native-only units with NO reference analog; each entry names why.
+# Directory entries (trailing '/') cover a whole subpackage.
+CITATION_ALLOWLIST = {
+    "compat.py": "jax-version bridge for THIS image (pre-vma 0.4.37); "
+                 "no reference analog",
+    "elastic.py": "elastic scaling lives in KungFu's external runtime, "
+                  "not the reference repo (SURVEY 2.9); TPU-native "
+                  "design module",
+    "telemetry.py": "runtime training-health layer; the reference's "
+                    "observability is post-hoc only (SURVEY 5.1/9)",
+    "analysis/": "static program-contract auditor + this lint; the "
+                 "reference analog is its graph-mode structure checks "
+                 "as a TECHNIQUE (SURVEY 2), not a citable file -- see "
+                 "MIGRATION.md 'Graph-structure assumptions'",
+}
+
+
+# -- file plumbing -----------------------------------------------------------
+
+class _Source(NamedTuple):
+  path: str          # repo-relative
+  text: str
+  lines: List[str]
+  tree: Optional[ast.AST]
+  doc_lines: Dict[int, str]      # line -> comment/string text on that line
+  comment_lines: Dict[int, str]  # line -> comment text only
+
+
+def _doc_lines(text: str, tree: Optional[ast.AST]):
+  """(comments+strings, comments-only) text by line: the 'documentation
+  channel' the version-gate rule searches for API names. The
+  comments-only channel lets the rule discard a gate's own string
+  argument without also discarding a trailing comment on that line."""
+  out: Dict[int, str] = {}
+  comments: Dict[int, str] = {}
+
+  def add(d: Dict[int, str], line: int, s: str) -> None:
+    d[line] = d.get(line, "") + " " + s
+
+  try:
+    for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+      if tok.type == tokenize.COMMENT:
+        add(out, tok.start[0], tok.string)
+        add(comments, tok.start[0], tok.string)
+  except (tokenize.TokenError, IndentationError):
+    pass  # malformed file: the string channel below still applies
+  if tree is not None:
+    for node in ast.walk(tree):
+      if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        for line in range(node.lineno, (node.end_lineno or node.lineno) + 1):
+          add(out, line, node.value)
+  return out, comments
+
+
+def iter_sources(root: str) -> List[_Source]:
+  files = []
+  for entry in sorted(os.listdir(root)):
+    full = os.path.join(root, entry)
+    if entry.endswith(".py") and os.path.isfile(full):
+      files.append(entry)
+    elif entry in _SCAN_DIRS and os.path.isdir(full):
+      for dirpath, dirnames, filenames in os.walk(full):
+        dirnames[:] = [d for d in sorted(dirnames) if d not in _SKIP_PARTS]
+        for name in sorted(filenames):
+          if name.endswith(".py"):
+            rel = os.path.relpath(os.path.join(dirpath, name), root)
+            files.append(rel.replace(os.sep, "/"))
+  sources = []
+  for rel in files:
+    text = open(os.path.join(root, rel), encoding="utf-8").read()
+    try:
+      tree = ast.parse(text)
+    except SyntaxError:
+      tree = None
+    docs, comments = _doc_lines(text, tree)
+    sources.append(_Source(rel, text, text.splitlines(), tree, docs,
+                           comments))
+  return sources
+
+
+def _enclosing_function_text(src: _Source, lineno: int) -> str:
+  """Source text of the smallest def containing ``lineno`` (module
+  +-30 lines when at top level) -- the context window the kill-timeout
+  rule inspects for TPU-boundness."""
+  best = None
+  if src.tree is not None:
+    for node in ast.walk(src.tree):
+      if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        end = node.end_lineno or node.lineno
+        if node.lineno <= lineno <= end:
+          if best is None or (end - node.lineno) < (
+              (best.end_lineno or best.lineno) - best.lineno):
+            best = node
+  if best is not None:
+    return "\n".join(src.lines[best.lineno - 1:(best.end_lineno or
+                                                best.lineno)])
+  lo, hi = max(0, lineno - 31), min(len(src.lines), lineno + 30)
+  return "\n".join(src.lines[lo:hi])
+
+
+def _stale_allowlist(rule: str, allowlist: Dict[str, str],
+                     hit_paths, known_paths) -> List[LintViolation]:
+  out = []
+  for path, why in sorted(allowlist.items()):
+    if path not in known_paths:
+      out.append(LintViolation(rule, path, 0,
+                               f"stale allowlist entry (file gone): {why}"))
+    elif path not in hit_paths:
+      out.append(LintViolation(
+          rule, path, 0,
+          "stale allowlist entry (no longer trips the rule) -- remove "
+          f"it: {why}"))
+  return out
+
+
+# -- rule: block-until-ready -------------------------------------------------
+
+def rule_block_until_ready(sources: List[_Source]) -> List[LintViolation]:
+  out, hits = [], set()
+  for src in sources:
+    if src.path == "kf_benchmarks_tpu/utils/sync.py" or src.tree is None:
+      continue
+    for node in ast.walk(src.tree):
+      if isinstance(node, ast.Attribute) and \
+          node.attr == "block_until_ready":
+        hits.add(src.path)
+        if src.path in BLOCK_UNTIL_READY_ALLOWLIST:
+          continue
+        out.append(LintViolation(
+            "block-until-ready", src.path, node.lineno,
+            "jax.block_until_ready returns before device execution "
+            "completes on the tunneled backend (CLAUDE.md); use "
+            "kf_benchmarks_tpu.utils.sync.drain at wall-clock "
+            "boundaries"))
+  out += _stale_allowlist("block-until-ready", BLOCK_UNTIL_READY_ALLOWLIST,
+                          hits, {s.path for s in sources})
+  return out
+
+
+# -- rule: version-gate-comment ----------------------------------------------
+
+def _gate_attr(node: ast.Call) -> Optional[str]:
+  """The gated attr name when ``node`` is a jax version probe
+  (hasattr(jax[.lax], "attr")), else None."""
+  if not (isinstance(node.func, ast.Name) and node.func.id == "hasattr"
+          and len(node.args) == 2
+          and isinstance(node.args[1], ast.Constant)
+          and isinstance(node.args[1].value, str)):
+    return None
+  target = ast.unparse(node.args[0])
+  if target == "jax" or target.endswith("lax") or target.startswith("jax."):
+    return node.args[1].value
+  return None
+
+
+def rule_version_gate_comment(sources: List[_Source]
+                              ) -> List[LintViolation]:
+  out, hits = [], set()
+  for src in sources:
+    if src.tree is None:
+      continue
+    gates = []
+    for node in ast.walk(src.tree):
+      if isinstance(node, ast.Call):
+        attr = _gate_attr(node)
+        if attr is not None:
+          gates.append((node.lineno, attr, node.args[1].lineno))
+      elif isinstance(node, ast.Compare):
+        names = {ast.unparse(n) for n in ast.walk(node)
+                 if isinstance(n, ast.Attribute)}
+        if any(n.endswith("__version__") and "jax" in n for n in names):
+          gates.append((node.lineno, "version", node.lineno))
+    for lineno, attr, arg_line in gates:
+      # The documentation channel: comments/strings in the surrounding
+      # window. On the gate's own argument line only COMMENTS count
+      # (hasattr's string arg names the attr by construction, but a
+      # trailing comment there is legitimate documentation).
+      window = ""
+      for line in range(max(1, lineno - 12), lineno + 4):
+        channel = (src.comment_lines if line == arg_line
+                   else src.doc_lines)
+        window += channel.get(line, "")
+      if attr in window:
+        continue
+      hits.add(src.path)
+      if src.path in VERSION_GATE_ALLOWLIST:
+        continue
+      out.append(LintViolation(
+          "version-gate-comment", src.path, lineno,
+          f"version gate on {attr!r} without a nearby comment naming "
+          "the missing API (CLAUDE.md: gates must say what API absence "
+          "they bridge, so they can be retired when it lands)"))
+  out += _stale_allowlist("version-gate-comment", VERSION_GATE_ALLOWLIST,
+                          hits, {s.path for s in sources})
+  return out
+
+
+# -- rule: kill-timeout ------------------------------------------------------
+
+_SUBPROCESS_ATTRS = {"run", "call", "check_call", "check_output",
+                     "communicate", "wait", "Popen"}
+_TPU_MARKERS = ("--device=tpu", "device=tpu", 'pop("JAX_PLATFORMS"',
+                "pop('JAX_PLATFORMS'")
+
+
+def rule_kill_timeout(sources: List[_Source]) -> List[LintViolation]:
+  out, hits = [], set()
+  for src in sources:
+    if not src.path.startswith("tests/") or src.tree is None:
+      continue
+    for node in ast.walk(src.tree):
+      if not (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr in _SUBPROCESS_ATTRS
+              and any(kw.arg == "timeout" for kw in node.keywords)):
+        continue
+      context = _enclosing_function_text(src, node.lineno)
+      if not any(marker in context for marker in _TPU_MARKERS):
+        continue
+      hits.add(src.path)
+      if src.path in KILL_TIMEOUT_ALLOWLIST:
+        continue
+      out.append(LintViolation(
+          "kill-timeout", src.path, node.lineno,
+          "kill-based timeout= around a TPU-bound subprocess: the "
+          "timeout kill mid-claim is the tunnel-wedge trigger "
+          "(CLAUDE.md round-4 incident) -- monitor without killing, "
+          "or drop the timeout"))
+  out += _stale_allowlist("kill-timeout", KILL_TIMEOUT_ALLOWLIST, hits,
+                          {s.path for s in sources})
+  return out
+
+
+# -- rule: step-line-format --------------------------------------------------
+
+# Concatenated so this module's own constants never contain the marker
+# (the rule scans every package file, this one included).
+_STEP_LINE_MARKER = "images/sec" + ":"
+_STEP_LINE_HOME = "kf_benchmarks_tpu/utils/log.py"
+
+
+def rule_step_line_format(sources: List[_Source]) -> List[LintViolation]:
+  out = []
+  for src in sources:
+    if not (src.path.startswith("kf_benchmarks_tpu/")
+            or src.path == "bench.py"):
+      continue
+    if src.path == _STEP_LINE_HOME or src.tree is None:
+      continue
+    for node in ast.walk(src.tree):
+      if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+          and _STEP_LINE_MARKER in node.value:
+        out.append(LintViolation(
+            "step-line-format", src.path, node.lineno,
+            f"step-line format literal outside {_STEP_LINE_HOME}: tests "
+            "scrape stdout against the single-sourced format "
+            "(utils/log.py format_step_line/format_total_line); call "
+            "the helper instead of re-stating the literal"))
+  return out
+
+
+# -- rule: flag-validation ---------------------------------------------------
+
+def _registry_flags(src: _Source) -> List[str]:
+  names = []
+  if src.tree is None:
+    return names
+  for node in ast.walk(src.tree):
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+        and node.func.attr.startswith("DEFINE_") and node.args
+        and isinstance(node.args[0], ast.Constant)
+        and isinstance(node.args[0].value, str)):
+      names.append(node.args[0].value)
+  return names
+
+
+def _marker_dict(src: _Source):
+  """(entries, lineno_span) of validation.py's NO_CROSS_FLAG_VALIDATION
+  marker dict, or ({}, None)."""
+  if src.tree is None:
+    return {}, None
+  for node in ast.walk(src.tree):
+    if (isinstance(node, ast.Assign) and len(node.targets) == 1
+        and isinstance(node.targets[0], ast.Name)
+        and node.targets[0].id == "NO_CROSS_FLAG_VALIDATION"
+        and isinstance(node.value, ast.Dict)):
+      entries = {}
+      for k, v in zip(node.value.keys, node.value.values):
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+          entries[k.value] = (ast.unparse(v) if not isinstance(
+              v, ast.Constant) else v.value)
+      return entries, (node.lineno, node.end_lineno or node.lineno)
+  return {}, None
+
+
+def rule_flag_validation(sources: List[_Source]) -> List[LintViolation]:
+  by_path = {s.path: s for s in sources}
+  params_src = by_path.get("kf_benchmarks_tpu/params.py")
+  val_src = by_path.get("kf_benchmarks_tpu/validation.py")
+  if params_src is None or val_src is None:
+    return []
+  flags = _registry_flags(params_src)
+  marked, span = _marker_dict(val_src)
+  # Mentions are searched OUTSIDE the marker dict (a marker entry must
+  # not count as validation coverage).
+  lines = list(val_src.lines)
+  if span is not None:
+    for line in range(span[0], span[1] + 1):
+      lines[line - 1] = ""
+  val_text = "\n".join(lines)
+  out = []
+  for name in flags:
+    mentioned = re.search(rf"\b{re.escape(name)}\b", val_text)
+    if mentioned and name in marked:
+      out.append(LintViolation(
+          "flag-validation", "kf_benchmarks_tpu/validation.py", span[0],
+          f"stale NO_CROSS_FLAG_VALIDATION marker: --{name} now appears "
+          "in validation.py -- remove the marker entry"))
+    elif not mentioned and name not in marked:
+      out.append(LintViolation(
+          "flag-validation", "kf_benchmarks_tpu/params.py", 0,
+          f"--{name} neither appears in validation.py nor carries a "
+          "NO_CROSS_FLAG_VALIDATION marker entry (validation.py): add "
+          "a cross-flag check or an explicit reasoned marker"))
+  for name in marked:
+    if name not in flags:
+      out.append(LintViolation(
+          "flag-validation", "kf_benchmarks_tpu/validation.py",
+          span[0] if span else 0,
+          f"NO_CROSS_FLAG_VALIDATION marker for unknown flag --{name}"))
+  return out
+
+
+# -- rule: citation ----------------------------------------------------------
+
+_FILE_LINE_CITE = re.compile(r"[\w/.\-]+\.(?:py|cc|md|proto|sh):\d+")
+_MD_SECTION_CITE = re.compile(r'[\w/.\-]+\.md "[^"]+"')
+
+
+def _has_citation(text: str) -> bool:
+  return bool(_FILE_LINE_CITE.search(text) or _MD_SECTION_CITE.search(text))
+
+
+def rule_citation(sources: List[_Source]) -> List[LintViolation]:
+  pkg = "kf_benchmarks_tpu/"
+  modules = {}   # unit name ("foo.py" or "sub/") -> [texts]
+  for src in sources:
+    if not src.path.startswith(pkg):
+      continue
+    rel = src.path[len(pkg):]
+    if "/" in rel:
+      unit = rel.split("/", 1)[0] + "/"
+    else:
+      unit = rel
+    modules.setdefault(unit, []).append(src.text)
+  if len(modules) < 15:
+    # Guard against the walker silently matching nothing (e.g. a moved
+    # package): the tree this lint protects has >= 15 top-level units.
+    return [LintViolation("citation", pkg, 0,
+                          f"citation walker found only {len(modules)} "
+                          "units -- package moved?")]
+  out = []
+  for unit, texts in sorted(modules.items()):
+    cited = any(_has_citation(t) for t in texts)
+    if unit in CITATION_ALLOWLIST:
+      if cited:
+        out.append(LintViolation(
+            "citation", pkg + unit, 0,
+            "allowlist entry now carries a citation -- remove it from "
+            "CITATION_ALLOWLIST"))
+      continue
+    if not cited:
+      out.append(LintViolation(
+          "citation", pkg + unit, 0,
+          "module missing the reference file:line citation comment "
+          "(CLAUDE.md convention): cite the reference span it covers, "
+          "or add a CITATION_ALLOWLIST entry stating why there is no "
+          "analog"))
+  for unit, why in CITATION_ALLOWLIST.items():
+    if unit not in modules:
+      out.append(LintViolation(
+          "citation", pkg + unit, 0,
+          f"stale CITATION_ALLOWLIST entry (unit gone): {why}"))
+  return out
+
+
+# -- driver ------------------------------------------------------------------
+
+RULES = {
+    "block-until-ready": rule_block_until_ready,
+    "version-gate-comment": rule_version_gate_comment,
+    "kill-timeout": rule_kill_timeout,
+    "step-line-format": rule_step_line_format,
+    "flag-validation": rule_flag_validation,
+    "citation": rule_citation,
+}
+
+
+def run_lint(root: str = REPO,
+             rules: Optional[List[str]] = None) -> List[LintViolation]:
+  sources = iter_sources(root)
+  out: List[LintViolation] = []
+  for rule_id, rule in RULES.items():
+    if rules is not None and rule_id not in rules:
+      continue
+    out.extend(rule(sources))
+  return sorted(out)
+
+
+def main(argv=None) -> int:
+  import argparse
+  parser = argparse.ArgumentParser(description="repo hazard lint")
+  parser.add_argument("--root", default=REPO)
+  parser.add_argument("--rules", default=None,
+                      help="comma-separated rule ids (default: all)")
+  args = parser.parse_args(argv)
+  rules = args.rules.split(",") if args.rules else None
+  violations = run_lint(args.root, rules)
+  for v in violations:
+    print(v.render())
+  print(f"hazard lint: {len(violations)} violation(s) across "
+        f"{len(RULES if rules is None else rules)} rule(s)")
+  return 1 if violations else 0
+
+
+if __name__ == "__main__":
+  raise SystemExit(main())

@@ -551,7 +551,7 @@ class BenchmarkCNN:
     shape = (self.batch_size_per_device,) + self._model_image_shape()
     images = jax.random.uniform(jax.random.PRNGKey(p.tf_random_seed or 0),
                                 shape, jnp.float32)
-    jax.block_until_ready(images)
+    sync.drain(images)  # block_until_ready lies on this backend
     log_fn("Running warm up")
     t0 = time.time()
     for _ in range(max(self.num_warmup_batches, 1)):
@@ -591,7 +591,7 @@ class BenchmarkCNN:
     images_per_sec = (self.num_batches * self.batch_size_per_device /
                       max(total_time, 1e-9))
     log_fn("-" * 64)
-    log_fn("total images/sec: %.2f" % images_per_sec)
+    log_fn(log_util.format_total_line(images_per_sec))
     log_fn("-" * 64)
     return {
         "num_workers": 1,
@@ -1282,7 +1282,7 @@ class BenchmarkCNN:
     average_wall_time = total_time / num_steps if num_steps else 0
     images_per_sec = images_processed / total_time
     log_fn("-" * 64)
-    log_fn("total images/sec: %.2f" % images_per_sec)
+    log_fn(log_util.format_total_line(images_per_sec))
     log_fn("-" * 64)
     if chunked and chunk_times:
       # Per-chunk timing rows: the dispatch-granularity wall clock the

@@ -452,13 +452,19 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
         ost2 = _sync_schedule_counts(ost, ost2)
         return (optax.apply_updates(prms, upd), ost2), None
 
-      (new_params, new_opt_state), _ = lax.scan(
-          _apply_one, (model_params_pre, opt_state), g_all)
+      # The named_scope rides into HLO op_name metadata; the program-
+      # contract auditor (analysis/contracts.py) keys the one-apply-
+      # per-step check on it.
+      with jax.named_scope("optimizer_apply"):
+        (new_params, new_opt_state), _ = lax.scan(
+            _apply_one, (model_params_pre, opt_state), g_all)
       new_opt_state = _sync_schedule_counts(opt_state, new_opt_state,
                                             bump=1)
     else:
-      updates, new_opt_state = tx.update(grads, opt_state, model_params_pre)
-      new_params = optax.apply_updates(model_params_pre, updates)
+      with jax.named_scope("optimizer_apply"):
+        updates, new_opt_state = tx.update(grads, opt_state,
+                                           model_params_pre)
+        new_params = optax.apply_updates(model_params_pre, updates)
     new_params = strategy.post_update(new_params, state.step, REPLICA_AXIS)
     new_bs = strategy.sync_batch_stats(new_bs, REPLICA_AXIS)
 

@@ -55,3 +55,12 @@ def format_step_line(step: int, batch_size: int,
   if lr is not None:
     log_str += f"\t{lr:.5f}"
   return log_str
+
+
+def format_total_line(images_per_sec: float) -> str:
+  """The run-summary throughput line (ref: benchmark_cnn.py:2351-2354).
+
+  Single-sourced here with the step-line format above: tests scrape
+  stdout for both, and the hazard lint (analysis/lint.py rule
+  'step-line-format') rejects a second copy of either literal."""
+  return "total images/sec: %.2f" % images_per_sec

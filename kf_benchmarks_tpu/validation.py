@@ -19,6 +19,138 @@ class ParamError(ValueError):
   pass
 
 
+# Flags with NO cross-flag constraint, each with the reason -- the
+# explicit no-validation marker the hazard lint requires (analysis/
+# lint.py rule 'flag-validation'): every flag in the params registry
+# must either appear in validate_cross_flags below or carry an entry
+# here, so a new flag cannot silently skip validation. A flag that
+# appears in BOTH is a stale marker and fails the lint.
+NO_CROSS_FLAG_VALIDATION = {
+    # Optimizer hyperparameters: numerically free knobs; the per-spec
+    # bounds in the flags registry are the whole contract.
+    "adam_beta1": "free hyperparameter (registry bounds only)",
+    "adam_beta2": "free hyperparameter (registry bounds only)",
+    "adam_epsilon": "free hyperparameter (registry bounds only)",
+    "momentum": "free hyperparameter (registry bounds only)",
+    "rmsprop_decay": "free hyperparameter (registry bounds only)",
+    "rmsprop_epsilon": "free hyperparameter (registry bounds only)",
+    "rmsprop_momentum": "free hyperparameter (registry bounds only)",
+    "weight_decay": "free hyperparameter (registry bounds only)",
+    "gradient_clip": "free hyperparameter; None disables",
+    "fp16_loss_scale": "numeric knob; engagement gated by use_fp16 "
+                       "checks above",
+    "fp16_inc_loss_scale_every_n": "numeric knob of the auto-loss-scale "
+                                   "machine; engagement validated via "
+                                   "fp16_enable_auto_loss_scale",
+    "single_l2_loss_op": "numerically identical formulation toggle "
+                         "(train_step.l2_loss)",
+    # Display / logging / artifact sinks: consumed as-is by the
+    # observability layer; any path works, nothing to cross-check.
+    "display_every": "display cadence only",
+    "print_training_accuracy": "adds metric columns only",
+    "benchmark_log_dir": "artifact sink path",
+    "benchmark_test_id": "artifact metadata string",
+    "eval_dir": "artifact sink path",
+    "eval_interval_secs": "eval-loop cadence only",
+    "train_dir": "artifact sink path (checkpoints/recorder)",
+    "save_summaries_steps": "summary cadence only",
+    "summary_verbosity": "summary tier selector (observability.py caps)",
+    "loss_type_to_report": "display column selector",
+    "use_chrome_trace_format": "trace file format toggle",
+    "max_ckpts_to_keep": "checkpoint GC depth",
+    "tf_random_seed": "seed value; any int is valid",
+    "num_warmup_batches": "None = runtime default (benchmark.py:_run)",
+    # Input pipeline knobs: consumed by data/ preprocessing with safe
+    # fallbacks; no cross-flag interaction.
+    "data_dir": "dataset path; synthetic when unset",
+    "data_name": "dataset selector; inferred from data_dir when unset",
+    "batch_group_size": "host pipeline batching depth",
+    "distortions": "preprocessing toggle",
+    "distort_color_in_yiq": "preprocessing toggle",
+    "resize_method": "preprocessing method selector",
+    "fuse_decode_and_crop": "preprocessing toggle",
+    "input_preprocessor": "preprocessor selector (datasets resolve it)",
+    "input_preprocessing_parallelism": "host thread count",
+    "datasets_num_private_threads": "host thread count",
+    "datasets_parallel_interleave_cycle_length": "accepted for reference "
+                                                 "CLI parity; interleave "
+                                                 "is TF-pipeline-only",
+    "datasets_parallel_interleave_prefetch": "accepted for reference CLI "
+                                             "parity; TF-pipeline-only",
+    "datasets_prefetch_buffer_size": "feeder prefetch depth",
+    "datasets_repeat_cached_sample": "pipeline toggle",
+    "datasets_sloppy_parallel_interleave": "accepted for reference CLI "
+                                           "parity; TF-pipeline-only",
+    "datasets_use_caching": "pipeline toggle",
+    "datasets_use_prefetch": "pipeline toggle",
+    "use_synthetic_gpu_images": "forces synthetic inputs; benchmark.py "
+                                "consumes directly",
+    "use_multi_device_iterator": "accepted for reference CLI parity; the "
+                                 "DeviceFeeder is the only input path",
+    "multi_device_iterator_max_buffer_size": "accepted for reference CLI "
+                                             "parity (see above)",
+    # Telemetry knobs (PR 4): numeric thresholds with registry bounds;
+    # engagement is validated through health_stats above.
+    "health_grad_norm_sigma": "anomaly threshold (registry bounds only)",
+    "flight_recorder_window": "ring size (registry bounds only)",
+    "stall_watchdog_factor": "watchdog threshold; 0 disables",
+    "elastic_check_every_n_steps": "resize-poll cadence only",
+    # Cluster wiring: free-form host lists/ids consumed by cluster.py;
+    # the modes that REQUIRE them are validated via job_name above.
+    "ps_hosts": "cluster wiring string (cluster.py)",
+    "worker_hosts": "cluster wiring string (cluster.py)",
+    "task_index": "cluster wiring index (cluster.py)",
+    "process_index": "cluster wiring index (cluster.py)",
+    "num_processes": "cluster wiring count (kfrun.py)",
+    "horovod_device": "accepted for reference CLI parity; TPU runs have "
+                      "no per-process device pick",
+    "server_protocol": "accepted for reference CLI parity; no grpc "
+                       "server exists here",
+    "sync_on_finish": "accepted for reference CLI parity; drain() is "
+                      "unconditional at run end",
+    # GPU/TF-graph knobs accepted for reference command-line parity but
+    # inert on this backend (params.validate_params notes them; SURVEY
+    # 5.6 library/CLI duality keeps reference invocations working).
+    "allow_growth": "inert GPU allocator knob (reference parity)",
+    "autotune_threshold": "inert TF autotune knob (reference parity)",
+    "backbone_model_path": "SSD backbone restore path; model-private",
+    "batchnorm_persistent": "inert cuDNN knob (reference parity)",
+    "compute_lr_on_cpu": "inert placement knob (reference parity)",
+    "enable_optimizations": "inert TF graph-option (reference parity)",
+    "force_gpu_compatible": "inert GPU knob (reference parity)",
+    "freeze_when_forward_only": "subsumed by aot_save_path validation "
+                                "(the freeze analog)",
+    "gpu_indices": "inert GPU knob (reference parity)",
+    "gpu_memory_frac_for_testing": "inert GPU knob (reference parity)",
+    "gpu_thread_mode": "inert GPU knob (reference parity)",
+    "per_gpu_thread_count": "inert GPU knob (reference parity)",
+    "kmp_affinity": "inert MKL env knob (reference parity)",
+    "kmp_blocktime": "inert MKL env knob (reference parity)",
+    "kmp_settings": "inert MKL env knob (reference parity)",
+    "mkl": "inert MKL toggle (reference parity)",
+    "num_inter_threads": "host thread pool size",
+    "num_intra_threads": "host thread pool size",
+    "rewriter_config": "inert TF graph-rewriter knob (reference parity)",
+    "sparse_to_dense_grads": "inert: JAX grads are dense already",
+    "use_python32_barrier": "inert TF threading knob (reference parity)",
+    "use_resource_vars": "inert TF variable knob (reference parity)",
+    "use_tf_layers": "builder always uses flax modules (reference parity)",
+    "use_unified_memory": "inert GPU knob (reference parity)",
+    "winograd_nonfused": "inert cuDNN env knob (reference parity)",
+    "partitioned_graph_file_prefix": "inert TF graph-dump knob "
+                                     "(reference parity)",
+    "trt_max_workspace_size_bytes": "inert TRT knob; trt_mode itself IS "
+                                    "validated above",
+    "xla_compile": "legacy alias surface; use_xla_compile is the "
+                   "validated switch",
+    "allreduce_merge_scope": "reducer batching depth (ops/allreduce.py)",
+    "agg_small_grads_max_group": "reducer group bound; engagement "
+                                 "validated via agg_small_grads_max_bytes",
+    "network_topology": "hierarchical-copy shape hint (ops/allreduce.py)",
+    "local_parameter_device": "PS placement hint; no TPU cross-check",
+}
+
+
 def eval_during_training_enabled(params) -> bool:
   """Any of the four mid-training eval schedules set
   (ref: benchmark_cnn.py:1317-1327)."""
@@ -95,6 +227,12 @@ def validate_cross_flags(params) -> None:
           "--num_grad_accum > 1 cannot be combined with "
           "--adaptive_batch_size: the policy re-picks the per-device "
           "batch mid-run and cannot guarantee divisibility by M")
+  if (p.adaptive_batch_size and
+      p.adaptive_batch_min > p.adaptive_batch_max):
+    raise ParamError(
+        f"--adaptive_batch_min={p.adaptive_batch_min} exceeds "
+        f"--adaptive_batch_max={p.adaptive_batch_max}: the adaptive "
+        "policy's search interval is empty")
   if p.num_epochs is not None and p.num_epochs <= 0:
     raise ParamError("--num_epochs must be positive")
   if p.num_eval_batches is not None and p.num_eval_epochs is not None:

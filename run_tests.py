@@ -14,15 +14,30 @@ Usage:
     python run_tests.py --run_distributed_tests  # process-spawning suite
     python run_tests.py --report-slowest[=N]     # + top-N duration table
     python run_tests.py --check-tiering          # FAIL on >60s non-slow tests
+    python run_tests.py --audit                  # static lint target (<60 s)
+
+``--audit`` is the one fast CI lint target (CPU-only, no device work,
+<60 s): the hazard lint (kf_benchmarks_tpu/analysis/lint.py), the
+program-contract audit against tests/golden_contracts/, and the
+tiering audit (the static half always: the SLOW/DISTRIBUTED file lists
+must name real files; the dynamic 60 s rule re-checks the durations
+report saved by the last --check-tiering run, which is the only part
+that needs a real suite run).
 """
 
 import argparse
+import json
 import os
 import re
 import subprocess
 import sys
+import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
+
+# Durations report the --check-tiering run saves and --audit re-checks
+# (pytest does not persist durations itself).
+TIERING_REPORT = os.path.join(REPO, ".pytest_cache", "tiering_report.json")
 
 # The tiering rule from CLAUDE.md: a test outside the @pytest.mark.slow
 # marker must stay under this call duration, or the tier-1 suite
@@ -91,12 +106,93 @@ def tiering_violations(pytest_output: str,
   durations flags (--report-slowest data works too). Only the 'call'
   phase counts -- setup/teardown time is fixture cost, not the test's
   tiering decision. Returns [(seconds, test_id), ...] slowest first."""
-  viols = []
+  return sorted((row for row in parse_durations(pytest_output)
+                 if row[0] > budget_s), reverse=True)
+
+
+def parse_durations(pytest_output: str):
+  """[(seconds, test_id), ...] of every 'call' row in a pytest
+  durations table (the raw data tiering_violations filters)."""
+  rows = []
   for line in pytest_output.splitlines():
     m = re.match(r"\s*(\d+(?:\.\d+)?)s\s+call\s+(\S+)", line)
-    if m and float(m.group(1)) > budget_s:
-      viols.append((float(m.group(1)), m.group(2)))
-  return sorted(viols, reverse=True)
+    if m:
+      rows.append((float(m.group(1)), m.group(2)))
+  return rows
+
+
+def save_tiering_report(pytest_output: str) -> None:
+  os.makedirs(os.path.dirname(TIERING_REPORT), exist_ok=True)
+  with open(TIERING_REPORT, "w", encoding="utf-8") as f:
+    json.dump({"time": time.time(),
+               "durations": parse_durations(pytest_output)}, f)
+
+
+def audit_tiering_static():
+  """The static half of the tiering audit: the tier lists must name
+  files that exist (a renamed suite would silently fall out of its
+  tier), plus the saved durations re-check when a report exists.
+  Returns (ok, lines)."""
+  lines, ok = [], True
+  for name in DISTRIBUTED_TESTS + SLOW_TESTS:
+    if not os.path.exists(os.path.join(REPO, name)):
+      ok = False
+      lines.append(f"tiering: {name} is listed in run_tests.py but does "
+                   "not exist (renamed suite fell out of its tier?)")
+  if os.path.exists(TIERING_REPORT):
+    with open(TIERING_REPORT, encoding="utf-8") as f:
+      report = json.load(f)
+    viols = [(s, t) for s, t in report.get("durations", [])
+             if s > TIER1_TEST_BUDGET_S]
+    age_h = (time.time() - report.get("time", 0)) / 3600.0
+    if viols:
+      ok = False
+      for secs, test_id in sorted(viols, reverse=True):
+        lines.append(f"tiering: {secs:8.2f}s  {test_id} (> "
+                     f"{TIER1_TEST_BUDGET_S:.0f} s outside the slow "
+                     "marker; saved report)")
+    else:
+      lines.append(f"tiering: saved durations report OK "
+                   f"({age_h:.1f} h old)")
+  else:
+    lines.append("tiering: no saved durations report -- the dynamic "
+                 "60 s rule needs one full `python run_tests.py "
+                 "--check-tiering` run (static checks still enforced)")
+  return ok, lines
+
+
+def run_audit_target() -> int:
+  """The --audit lint target: hazard lint + program-contract audit +
+  tiering audit. CPU-only, no device execution, <60 s."""
+  failed = False
+  # 1. Hazard lint: pure AST. Loaded by FILE PATH, not as
+  # kf_benchmarks_tpu.analysis.lint -- the package __init__ imports
+  # jax, and the lint leg must run (fast) in any interpreter.
+  import importlib.util
+  spec = importlib.util.spec_from_file_location(
+      "kf_hazard_lint",
+      os.path.join(REPO, "kf_benchmarks_tpu", "analysis", "lint.py"))
+  lint = importlib.util.module_from_spec(spec)
+  spec.loader.exec_module(lint)
+  violations = lint.run_lint()
+  for v in violations:
+    print(v.render())
+  print(f"hazard lint: {len(violations)} violation(s)")
+  failed |= bool(violations)
+  # 2. Program contracts vs goldens: needs the 8-device virtual CPU
+  # mesh, so it runs in the analysis CLI's own interpreter (which sets
+  # XLA_FLAGS before the backend initializes).
+  rc = subprocess.call(
+      [sys.executable, "-m", "kf_benchmarks_tpu.analysis", "audit"],
+      cwd=REPO)
+  failed |= bool(rc)
+  # 3. Tiering audit (static + saved-report re-check).
+  ok, lines = audit_tiering_static()
+  for line in lines:
+    print(line)
+  failed |= not ok
+  print("audit target: " + ("FAIL" if failed else "OK"))
+  return 1 if failed else 0
 
 
 def main(argv=None):
@@ -117,7 +213,16 @@ def main(argv=None):
                            f"{TIER1_TEST_BUDGET_S:.0f} s rule (CLAUDE.md) "
                            "-- the CI guard for the 870 s tier-1 wall "
                            "budget")
+  parser.add_argument("--audit", action="store_true",
+                      help="the fast static lint target: hazard lint + "
+                           "program-contract audit vs goldens + tiering "
+                           "audit; CPU-only, no device work, <60 s")
   args, pytest_args = parser.parse_known_args(argv)
+  if args.audit:
+    if args.full_tests or args.run_distributed_tests or args.check_tiering:
+      parser.error("--audit is the standalone static target; run suite "
+                   "tiers separately")
+    return run_audit_target()
   if args.report_slowest is not None:
     try:
       args.report_slowest = int(args.report_slowest)
@@ -143,6 +248,9 @@ def main(argv=None):
     proc = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True)
     sys.stdout.write(proc.stdout)
     sys.stderr.write(proc.stderr)
+    # Persist the durations so `--audit` can re-check the 60 s rule
+    # statically between full runs.
+    save_tiering_report(proc.stdout)
     viols = tiering_violations(proc.stdout)
     if viols:
       print(f"TIERING VIOLATIONS (> {TIER1_TEST_BUDGET_S:.0f} s outside "

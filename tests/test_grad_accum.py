@@ -326,14 +326,10 @@ def test_accum_emits_one_reduction_collective_per_step():
     state = jax.jit(init_state)(jax.random.PRNGKey(0), x[:1])
     return train_step.lower(state, x, y).compile().as_text()
 
-  def grad_collectives(hlo):
-    defs = [ln for ln in hlo.splitlines()
-            if re.search(r"=\s+\S+\s+all-reduce(-start)?\(", ln)]
-    # Gradient traffic is the non-scalar all-reduce; f32[] reductions
-    # are the step's metric pmeans.
-    grad = [ln for ln in defs
-            if not re.search(r"=\s+\w+\[\]\s+all-reduce", ln)]
-    return defs, grad
+  # Shared HLO conventions (analysis/contracts.py): gradient traffic is
+  # the non-scalar all-reduce; f32[] reductions are the metric pmeans.
+  from kf_benchmarks_tpu.analysis.contracts import grad_all_reduce_defs \
+      as grad_collectives
 
   hlo_m4 = lowered_hlo(4)
   defs4, grad4 = grad_collectives(hlo_m4)

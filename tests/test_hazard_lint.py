@@ -1,0 +1,271 @@
+"""Hazard lint (kf_benchmarks_tpu/analysis/lint.py).
+
+Layers:
+  * acceptance: the lint is CLEAN at HEAD (every CLAUDE.md hazard rule
+    holds on the real tree, with its reasoned allowlists), and exits
+    nonzero on each seeded violation class.
+  * seeded violations in throwaway repo layouts (tmp_path): banned
+    ``jax.block_until_ready``, an uncommented version gate, a
+    kill-based timeout around a TPU-bound subprocess, a second
+    step-line literal, an unvalidated flag -- each caught by exactly
+    the intended rule, and each rule's negative (compliant) twin stays
+    clean.
+  * allowlist staleness: entries that stop tripping their rule are
+    themselves violations, so allowlists cannot rot.
+
+The lint is pure stdlib; these tests never build a mesh.
+"""
+
+import os
+
+import pytest
+
+from kf_benchmarks_tpu.analysis import lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _seed(tmp_path, rel, text):
+  path = tmp_path / rel
+  path.parent.mkdir(parents=True, exist_ok=True)
+  path.write_text(text)
+  return path
+
+
+@pytest.fixture
+def empty_allowlists(monkeypatch):
+  """Seeded-tree tests run with the HEAD allowlists cleared: those
+  entries reference real-repo paths, which read as 'file gone' stale
+  entries under a tmp root."""
+  monkeypatch.setattr(lint, "BLOCK_UNTIL_READY_ALLOWLIST", {})
+  monkeypatch.setattr(lint, "VERSION_GATE_ALLOWLIST", {})
+  monkeypatch.setattr(lint, "KILL_TIMEOUT_ALLOWLIST", {})
+
+
+def _rules(tmp_path, rule):
+  return [v for v in lint.run_lint(str(tmp_path), rules=[rule])]
+
+
+# -- acceptance: clean at HEAD ------------------------------------------------
+
+def test_lint_clean_at_head():
+  violations = lint.run_lint(REPO)
+  assert not violations, "\n".join(v.render() for v in violations)
+
+
+def test_cli_zero_at_head(capsys):
+  assert lint.main(["--root", REPO]) == 0
+
+
+# -- block-until-ready --------------------------------------------------------
+
+BLOCKED = "import jax\n\ndef f(x):\n  jax.block_until_ready(x)\n"
+
+
+def test_block_until_ready_seeded(tmp_path, empty_allowlists):
+  _seed(tmp_path, "kf_benchmarks_tpu/foo.py", BLOCKED)
+  violations = _rules(tmp_path, "block-until-ready")
+  assert [v.path for v in violations] == ["kf_benchmarks_tpu/foo.py"]
+  assert violations[0].line == 4
+  # ...and the CLI exits nonzero on it (the acceptance bar).
+  assert lint.main(["--root", str(tmp_path),
+                    "--rules", "block-until-ready"]) == 1
+
+
+def test_block_until_ready_allowed_in_sync(tmp_path, empty_allowlists):
+  _seed(tmp_path, "kf_benchmarks_tpu/utils/sync.py", BLOCKED)
+  _seed(tmp_path, "kf_benchmarks_tpu/ok.py",
+        "from kf_benchmarks_tpu.utils import sync\n\n"
+        "def f(x):\n  sync.drain(x)\n")
+  assert not _rules(tmp_path, "block-until-ready")
+
+
+def test_block_until_ready_method_form_caught(tmp_path, empty_allowlists):
+  _seed(tmp_path, "tests/test_x.py",
+        "def f(out):\n  out.block_until_ready()\n")
+  assert _rules(tmp_path, "block-until-ready")
+
+
+# -- version-gate-comment -----------------------------------------------------
+
+def test_uncommented_version_gate_seeded(tmp_path, empty_allowlists):
+  _seed(tmp_path, "kf_benchmarks_tpu/gated.py",
+        "import jax\n\nif hasattr(jax.lax, 'pcast'):\n  pass\n")
+  violations = _rules(tmp_path, "version-gate-comment")
+  assert [v.rule for v in violations] == ["version-gate-comment"]
+  assert "pcast" in violations[0].message
+  assert lint.main(["--root", str(tmp_path),
+                    "--rules", "version-gate-comment"]) == 1
+
+
+def test_commented_version_gate_clean(tmp_path, empty_allowlists):
+  _seed(tmp_path, "kf_benchmarks_tpu/gated.py",
+        "import jax\n\n"
+        "# lax.pcast is the missing API on pre-vma jax; identity there.\n"
+        "if hasattr(jax.lax, 'pcast'):\n  pass\n")
+  assert not _rules(tmp_path, "version-gate-comment")
+
+
+def test_trailing_comment_on_gate_line_counts(tmp_path, empty_allowlists):
+  # The comment channel on the gate's own line must survive the
+  # string-argument exclusion (hasattr's arg names the attr by
+  # construction, but a trailing comment there is documentation).
+  _seed(tmp_path, "kf_benchmarks_tpu/gated.py",
+        "import jax\n\n"
+        "if hasattr(jax.lax, 'pcast'):  # pcast missing pre-vma\n"
+        "  pass\n")
+  assert not _rules(tmp_path, "version-gate-comment")
+
+
+def test_version_compare_gate_needs_comment(tmp_path, empty_allowlists):
+  _seed(tmp_path, "kf_benchmarks_tpu/vers.py",
+        "import jax\n\nNEW = jax.__version__ >= '0.5'\n")
+  assert _rules(tmp_path, "version-gate-comment")
+  _seed(tmp_path, "kf_benchmarks_tpu/vers.py",
+        "import jax\n\n# version gate: shard_map API moved in 0.5\n"
+        "NEW = jax.__version__ >= '0.5'\n")
+  assert not _rules(tmp_path, "version-gate-comment")
+
+
+def test_non_jax_hasattr_is_not_a_gate(tmp_path, empty_allowlists):
+  _seed(tmp_path, "kf_benchmarks_tpu/attr.py",
+        "def f(leaf):\n  return hasattr(leaf, 'dtype')\n")
+  assert not _rules(tmp_path, "version-gate-comment")
+
+
+# -- kill-timeout -------------------------------------------------------------
+
+TPU_TIMEOUT = (
+    "import subprocess, sys\n\n"
+    "def run_tpu():\n"
+    "  return subprocess.run([sys.executable, '-m', 'x.cli',\n"
+    "                         '--device=tpu'],\n"
+    "                        capture_output=True, timeout=300)\n")
+
+
+def test_kill_timeout_around_tpu_subprocess_seeded(tmp_path, empty_allowlists):
+  _seed(tmp_path, "tests/test_x.py", TPU_TIMEOUT)
+  violations = _rules(tmp_path, "kill-timeout")
+  assert [v.rule for v in violations] == ["kill-timeout"]
+  assert lint.main(["--root", str(tmp_path),
+                    "--rules", "kill-timeout"]) == 1
+
+
+def test_kill_timeout_cpu_subprocess_clean(tmp_path, empty_allowlists):
+  _seed(tmp_path, "tests/test_x.py",
+        TPU_TIMEOUT.replace("--device=tpu", "--device=cpu"))
+  assert not _rules(tmp_path, "kill-timeout")
+
+
+def test_kill_timeout_stock_env_recipe_caught(tmp_path, empty_allowlists):
+  # The other TPU-bound marker: restoring the pinned axon platform by
+  # popping the overrides (tests/test_tpu_convergence.py's recipe).
+  _seed(tmp_path, "tests/test_x.py",
+        "import os, subprocess\n\n"
+        "def run_stock():\n"
+        "  env = dict(os.environ)\n"
+        "  env.pop('JAX_PLATFORMS', None)\n"
+        "  return subprocess.run(['x'], env=env, timeout=60)\n")
+  assert _rules(tmp_path, "kill-timeout")
+
+
+def test_timeout_outside_tests_dir_not_this_rules_business(tmp_path, empty_allowlists):
+  _seed(tmp_path, "experiments/probe.py", TPU_TIMEOUT)
+  assert not _rules(tmp_path, "kill-timeout")
+
+
+# -- step-line-format ---------------------------------------------------------
+
+def test_second_step_line_literal_seeded(tmp_path):
+  marker = "images/sec" + ":"
+  _seed(tmp_path, "kf_benchmarks_tpu/rogue.py",
+        f"LINE = '5\\t{marker} 100.0'\n")
+  violations = _rules(tmp_path, "step-line-format")
+  assert [v.path for v in violations] == ["kf_benchmarks_tpu/rogue.py"]
+
+
+def test_step_line_literal_allowed_in_log(tmp_path):
+  marker = "images/sec" + ":"
+  _seed(tmp_path, "kf_benchmarks_tpu/utils/log.py",
+        f"FMT = '{marker} %.1f'\n")
+  _seed(tmp_path, "tests/test_scrape.py",
+        f"RE = r'{marker} ([0-9.]+)'\n")  # scrapers pin the format
+  assert not _rules(tmp_path, "step-line-format")
+
+
+# -- flag-validation ----------------------------------------------------------
+
+PARAMS = ("from kf_benchmarks_tpu import flags\n\n"
+          "flags.DEFINE_boolean('mystery', False, 'help')\n"
+          "flags.DEFINE_integer('checked', 1, 'help')\n")
+
+
+def test_unvalidated_flag_seeded(tmp_path):
+  _seed(tmp_path, "kf_benchmarks_tpu/params.py", PARAMS)
+  _seed(tmp_path, "kf_benchmarks_tpu/validation.py",
+        "def validate(p):\n  assert p.checked\n")
+  violations = _rules(tmp_path, "flag-validation")
+  assert len(violations) == 1 and "--mystery" in violations[0].message
+
+
+def test_marker_satisfies_and_goes_stale(tmp_path):
+  _seed(tmp_path, "kf_benchmarks_tpu/params.py", PARAMS)
+  _seed(tmp_path, "kf_benchmarks_tpu/validation.py",
+        "NO_CROSS_FLAG_VALIDATION = {\n"
+        "    'mystery': 'display knob only',\n"
+        "}\n\n"
+        "def validate(p):\n  assert p.checked\n")
+  assert not _rules(tmp_path, "flag-validation")
+  # The flag later GAINS validation: the marker is now stale.
+  _seed(tmp_path, "kf_benchmarks_tpu/validation.py",
+        "NO_CROSS_FLAG_VALIDATION = {\n"
+        "    'mystery': 'display knob only',\n"
+        "}\n\n"
+        "def validate(p):\n  assert p.checked and p.mystery\n")
+  violations = _rules(tmp_path, "flag-validation")
+  assert len(violations) == 1 and "stale" in violations[0].message
+
+
+def test_marker_for_unknown_flag_flagged(tmp_path):
+  _seed(tmp_path, "kf_benchmarks_tpu/params.py", PARAMS)
+  _seed(tmp_path, "kf_benchmarks_tpu/validation.py",
+        "NO_CROSS_FLAG_VALIDATION = {\n"
+        "    'mystery': 'display knob only',\n"
+        "    'ghost': 'never defined',\n"
+        "}\n")
+  violations = _rules(tmp_path, "flag-validation")
+  assert any("ghost" in v.message and "unknown" in v.message
+             for v in violations)
+
+
+# -- malformed files ----------------------------------------------------------
+
+def test_malformed_file_does_not_crash_the_lint(tmp_path, empty_allowlists):
+  # An unclosed bracket raises tokenize.TokenError mid-scan (and
+  # SyntaxError in ast.parse); the lint must report on the rest of the
+  # tree, not die on the half-saved file.
+  _seed(tmp_path, "kf_benchmarks_tpu/halfsaved.py", "x = (\n")
+  _seed(tmp_path, "kf_benchmarks_tpu/foo.py", BLOCKED)
+  violations = _rules(tmp_path, "block-until-ready")
+  assert [v.path for v in violations] == ["kf_benchmarks_tpu/foo.py"]
+
+
+# -- allowlist staleness ------------------------------------------------------
+
+def test_stale_allowlist_entry_is_a_violation(tmp_path, monkeypatch):
+  _seed(tmp_path, "kf_benchmarks_tpu/clean.py", "X = 1\n")
+  monkeypatch.setattr(lint, "BLOCK_UNTIL_READY_ALLOWLIST",
+                      {"kf_benchmarks_tpu/clean.py": "test reason"})
+  violations = _rules(tmp_path, "block-until-ready")
+  assert len(violations) == 1 and "stale" in violations[0].message
+  # A file that still trips the rule keeps its entry quiet.
+  _seed(tmp_path, "kf_benchmarks_tpu/clean.py", BLOCKED)
+  assert not _rules(tmp_path, "block-until-ready")
+
+
+def test_every_head_allowlist_entry_is_live():
+  """The shipped allowlists must themselves be staleness-clean (covered
+  by test_lint_clean_at_head, but name the failure mode explicitly)."""
+  violations = [v for v in lint.run_lint(REPO)
+                if "stale" in v.message]
+  assert not violations, "\n".join(v.render() for v in violations)

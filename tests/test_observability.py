@@ -474,7 +474,8 @@ def test_run_tests_check_tiering_flags_and_parsing():
   assert rt.tiering_violations("no durations table") == []
 
 
-def test_run_tests_check_tiering_fails_on_violation(monkeypatch, capsys):
+def test_run_tests_check_tiering_fails_on_violation(monkeypatch, capsys,
+                                                    tmp_path):
   import importlib.util
   import subprocess as sp
   spec = importlib.util.spec_from_file_location(
@@ -482,6 +483,11 @@ def test_run_tests_check_tiering_fails_on_violation(monkeypatch, capsys):
                                  "run_tests.py"))
   rt = importlib.util.module_from_spec(spec)
   spec.loader.exec_module(rt)
+  # --check-tiering persists its durations for the --audit re-check;
+  # point that at a scratch path so the FAKE output below cannot
+  # poison the real repo's saved report.
+  monkeypatch.setattr(rt, "TIERING_REPORT",
+                      str(tmp_path / "tiering_report.json"))
 
   class FakeProc:
     def __init__(self, stdout):
@@ -497,9 +503,14 @@ def test_run_tests_check_tiering_fails_on_violation(monkeypatch, capsys):
   monkeypatch.setattr(rt.subprocess, "run", fake_run)
   assert rt.main(["--check-tiering"]) == 1
   assert "TIERING VIOLATIONS" in capsys.readouterr().out
+  # ...and the violating durations were persisted for --audit.
+  ok, lines = rt.audit_tiering_static()
+  assert not ok and any("test_big" in l for l in lines)
   outputs["out"] = "12 passed\n"
   assert rt.main(["--check-tiering"]) == 0
   assert "tiering check OK" in capsys.readouterr().out
+  ok, _ = rt.audit_tiering_static()
+  assert ok
   # The 60 s rule audits the fast tier only.
   import pytest as _pytest
   with _pytest.raises(SystemExit):

@@ -40,22 +40,11 @@ from kf_benchmarks_tpu.parallel.mesh import REPLICA_AXIS, build_mesh
 N_REPLICAS = 8
 
 
-# -- HLO helpers --------------------------------------------------------------
-
-_ALL_REDUCE_DEF = re.compile(r"=\s+\S+\s+all-reduce(-start)?\(")
-
-
-def _all_reduce_defs(hlo: str):
-  """All-reduce instruction definition lines of a compiled-HLO dump."""
-  return [ln for ln in hlo.splitlines() if _ALL_REDUCE_DEF.search(ln)]
-
-
-def _in_backward_loop(defs):
-  """Defs whose jax op_name places them inside a scanned (while) body --
-  the in-backward position the overlap hooks pin (the backward of a
-  lax.scan/nn.scan lowers to a while loop; a collective issued by a
-  hook inside it carries the loop in its op_name metadata)."""
-  return [ln for ln in defs if "while" in ln]
+# HLO-scraping conventions are single-sourced in analysis/contracts.py
+# (the program-contract auditor and these pins share one parser).
+from kf_benchmarks_tpu.analysis.contracts import (  # noqa: E402
+    all_reduce_defs as _all_reduce_defs,
+    in_backward_loop as _in_backward_loop)
 
 
 # -- pure-unit: validation -----------------------------------------------------

@@ -1,0 +1,253 @@
+"""Program-contract auditor (kf_benchmarks_tpu/analysis/).
+
+Layers, reference-style (SURVEY 7.1):
+  * pure-unit: HLO extraction on hand-built dumps (no jax needed for
+    the parser), and an end-to-end seeded program -- an extra psum
+    injected inside a scan body -- that the extractor must place
+    in-loop and the rule engine must reject.
+  * golden configs: every earned contract (one-collective accum,
+    in-backward overlap, no-(B,T,V)-buffer LM, health-no-extra-
+    collective, bf16-wire flag) verified by tracing each golden config
+    on the 8-device mesh, passing the full rule set, and matching the
+    checked-in golden fingerprint field-for-field.
+  * mutation self-tests: each seeded violation is caught by EXACTLY
+    the intended rule, so the auditor cannot rot into a
+    pass-everything stub.
+"""
+
+import copy
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kf_benchmarks_tpu.analysis import audit, baseline, contracts
+from kf_benchmarks_tpu.analysis.contracts import Collective
+from kf_benchmarks_tpu.parallel.mesh import REPLICA_AXIS
+
+
+@pytest.fixture(scope="module")
+def tracer():
+  """Memoized config -> ProgramContract tracer shared by the module
+  (each golden compiles once per pytest session)."""
+  return audit.make_memo_tracer()
+
+
+# -- pure-unit: the HLO parser ------------------------------------------------
+
+_FAKE_HLO = """\
+HloModule jit_step, input_output_alias={ {0}: (0, {}, may-alias), {1}: (1, {}, may-alias) }
+
+%region_0 { ... }
+ENTRY %main {
+  %ar0 = f32[] all-reduce(f32[] %loss), replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%region_0, metadata={op_name="jit(step)/pmean"}
+  %ar1 = bf16[4096,1001]{1,0} all-reduce(bf16[4096,1001]{1,0} %g), replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%region_0, metadata={op_name="jit(step)/grads"}
+  %ar2 = f32[1024]{0} all-reduce-start(f32[1024]{0} %h), replica_groups={{0,1,2,3},{4,5,6,7}}, metadata={op_name="jit(step)/while/body/hook"}
+  %cc = f32[8]{0} custom-call(f32[8]{0} %x), custom_call_target="TopK"
+  %u = f32[16]{0} add(f32[16]{0} %a, f32[16]{0} %b), metadata={op_name="jit(step)/optimizer_apply/add"}
+}
+"""
+
+
+def test_extract_contract_parses_hand_built_hlo():
+  c = contracts.extract_contract(_FAKE_HLO, config={"model": "fake"})
+  kinds = [(x.kind, x.dtype, x.scalar, x.in_loop) for x in c.collectives]
+  assert ("all-reduce", "f32", True, False) in kinds
+  assert ("all-reduce", "bf16", False, False) in kinds
+  assert ("all-reduce", "f32", False, True) in kinds  # the -start in-loop
+  assert len(c.collectives) == 3
+  grads = c.gradient_collectives()
+  assert {g.dtype for g in grads} == {"bf16", "f32"}
+  assert c.donated_buffers == 2
+  assert c.optimizer_apply_present and not c.optimizer_apply_in_loop
+  assert "TopK" in c.custom_call_targets
+  assert not c.host_transfers
+  # 4096*1001 bf16 is the biggest array in the dump.
+  assert c.largest_tensor_type == "bf16[4096,1001]"
+  assert c.largest_tensor_bytes == 4096 * 1001 * 2
+  # Partial replica groups survive extraction (the full-mesh rule
+  # keys on them).
+  assert any(x.replica_groups == "{{0,1,2,3},{4,5,6,7}}"
+             for x in c.collectives)
+
+
+def test_requested_wire_parser():
+  txt = ('x = "stablehlo.all_reduce"(%1) ({\n^bb0: ...\n})'
+         ' : (tensor<4101097xbf16>) -> tensor<4101097xbf16>\n'
+         'y = "stablehlo.all_reduce"(%2) ({\n})'
+         ' : (tensor<f32>) -> tensor<f32>\n')
+  wires = contracts.requested_all_reduce_wires(txt)
+  assert ("bf16", 4101097) in wires and ("f32", 1) in wires
+
+
+# -- pure-unit: seeded program with an extra in-scan psum ---------------------
+
+def test_injected_in_scan_psum_is_placed_in_loop_and_rejected():
+  """The end-to-end seed: a step-shaped program with a pmean inside a
+  lax.scan body. The extractor must place the collective in-loop, and
+  the rule engine must reject it for an overlap-off config."""
+  if len(jax.devices()) < 8:
+    pytest.skip("needs the 8-device virtual CPU mesh")
+  mesh = Mesh(np.array(jax.devices()[:8]), (REPLICA_AXIS,))
+
+  def body(x):
+    def step(carry, _):
+      # The seeded violation: a collective inside the scan body.
+      return carry + jax.lax.pmean(x.sum(), REPLICA_AXIS), None
+    out, _ = jax.lax.scan(step, jnp.float32(0), None, length=4)
+    return jax.lax.pmean(out, REPLICA_AXIS)
+
+  fn = jax.jit(jax.shard_map(body, mesh=mesh,
+                             in_specs=(P(REPLICA_AXIS),), out_specs=P()))
+  hlo = fn.lower(jnp.zeros((8, 4))).compile().as_text()
+  contract = contracts.extract_contract(hlo, config={})
+  assert contract.in_loop_collectives(), "extractor missed the in-scan psum"
+  violations = audit.audit_contract(
+      contract, rules={"overlap-in-backward":
+                       audit.rule_overlap_in_backward})
+  assert [v.rule for v in violations] == ["overlap-in-backward"]
+
+
+# -- golden configs: the earned contracts hold across the lattice -------------
+
+@pytest.mark.parametrize("name", list(contracts.GOLDEN_CONFIGS))
+def test_golden_config_passes_all_rules(name, tracer):
+  contract = tracer(contracts.GOLDEN_CONFIGS[name], "train_step")
+  violations = audit.audit_contract(contract, tracer)
+  assert not violations, [v.as_dict() for v in violations]
+
+
+@pytest.mark.parametrize("name", list(contracts.GOLDEN_CONFIGS))
+def test_golden_config_matches_checked_in_golden(name, tracer):
+  contract = tracer(contracts.GOLDEN_CONFIGS[name], "train_step")
+  diffs = baseline.check_against_golden(name, contract)
+  assert not diffs, (
+      "traced contract drifted from tests/golden_contracts/"
+      f"{name}.json: {diffs} -- if intentional, regenerate via "
+      "`python -m kf_benchmarks_tpu.analysis audit --write-goldens`")
+
+
+def test_earned_contract_shapes(tracer):
+  """The five earned contracts, spelled out against the traced goldens
+  (redundant with the rules on purpose: if a rule rots, this still
+  pins the shape)."""
+  accum = tracer(contracts.GOLDEN_CONFIGS["accum4_packed"], "train_step")
+  assert len(accum.gradient_collectives()) == 1
+  assert not accum.in_loop_collectives()
+  lm = tracer(contracts.GOLDEN_CONFIGS["lm_base"], "train_step")
+  assert lm.largest_tensor_bytes < lm.aux["btv_bytes"]
+  assert not lm.in_loop_collectives()
+  lm_over = tracer(contracts.GOLDEN_CONFIGS["lm_overlap"], "train_step")
+  assert len(lm_over.in_loop_collectives()) == 1
+  bf16 = tracer(contracts.GOLDEN_CONFIGS["overlap_bf16_wire"], "train_step")
+  assert bf16.aux["requested_grad_wires"] == ["bf16"]
+  plain = tracer(contracts.GOLDEN_CONFIGS["overlap"], "train_step")
+  assert plain.aux["requested_grad_wires"] == ["f32"]
+  health = tracer(contracts.GOLDEN_CONFIGS["health"], "train_step")
+  base = tracer(contracts.GOLDEN_CONFIGS["base"], "train_step")
+  n = lambda c: sum(1 for x in c.collectives if x.kind == "all-reduce")
+  assert n(health) <= n(base)
+
+
+# -- mutation self-tests: each seed caught by EXACTLY the intended rule -------
+
+def _add_collective(contract, **kw):
+  spec = dict(kind="all-reduce", dtype="f32", elems=1 << 20, scalar=False,
+              in_loop=False, replica_groups="")
+  spec.update(kw)
+  contract.collectives.append(Collective(**spec))
+
+
+MUTATIONS = [
+    ("extra_in_loop_psum", "base",
+     lambda c: _add_collective(c, in_loop=True),
+     "overlap-in-backward"),
+    ("extra_grad_collective_under_accum", "accum4_packed",
+     lambda c: _add_collective(c),
+     "accum-one-collective"),
+    ("psum_inside_microbatch_scan", "accum4_packed",
+     lambda c: _add_collective(c, in_loop=True),
+     "accum-one-collective"),
+    ("leaked_f32_wire", "overlap_bf16_wire",
+     lambda c: c.aux.update(requested_grad_wires=["bf16", "f32"]),
+     "wire-dtype"),
+    ("silent_bf16_downcast", "base",
+     lambda c: c.aux.update(requested_grad_wires=["bf16"]),
+     "wire-dtype"),
+    ("materialized_btv_logits", "lm_base",
+     lambda c: setattr(c, "largest_tensor_bytes", c.aux["btv_bytes"]),
+     "no-btv-buffer"),
+    # Two scalars: the health vector REPLACED two scalar loss pmeans,
+    # so the health-on program legitimately runs one collective below
+    # the stats-off twin; two extras break the <= bound unambiguously.
+    ("health_extra_collective", "health",
+     lambda c: (_add_collective(c, scalar=True, elems=1),
+                _add_collective(c, scalar=True, elems=1)),
+     "health-no-extra-collective"),
+    ("lost_donation", "base",
+     lambda c: setattr(c, "donated_buffers", 0),
+     "state-donated"),
+    ("optimizer_apply_in_scan", "base",
+     lambda c: setattr(c, "optimizer_apply_in_loop", True),
+     "single-optimizer-apply"),
+    ("optimizer_apply_missing", "base",
+     lambda c: setattr(c, "optimizer_apply_present", False),
+     "single-optimizer-apply"),
+    ("host_transfer_in_step", "base",
+     lambda c: c.host_transfers.append("outfeed"),
+     "no-host-transfer"),
+    ("partial_replica_groups", "base",
+     lambda c: _add_collective(c, elems=1 << 20,
+                               replica_groups="{{0,1,2,3},{4,5,6,7}}"),
+     "full-mesh-replica-groups"),
+    ("dropped_in_backward_hook", "lm_overlap",
+     lambda c: c.collectives.__setitem__(
+         slice(None), [x for x in c.collectives if not x.in_loop]),
+     "overlap-in-backward"),
+]
+
+
+@pytest.mark.parametrize("seed,config,mutate,expected",
+                         MUTATIONS, ids=[m[0] for m in MUTATIONS])
+def test_mutation_caught_by_exactly_the_intended_rule(
+    seed, config, mutate, expected, tracer):
+  contract = copy.deepcopy(tracer(contracts.GOLDEN_CONFIGS[config],
+                                  "train_step"))
+  # Clean before the seed...
+  assert not audit.audit_contract(contract, tracer)
+  mutate(contract)
+  violations = audit.audit_contract(contract, tracer)
+  fired = {v.rule for v in violations}
+  assert fired == {expected}, (
+      f"seed {seed!r}: expected exactly {{{expected!r}}}, got "
+      f"{sorted(fired)}: {[v.as_dict() for v in violations]}")
+
+
+# -- baseline: field-level golden diffs ---------------------------------------
+
+def test_golden_diff_names_the_field(tracer):
+  contract = tracer(contracts.GOLDEN_CONFIGS["base"], "train_step")
+  fp = baseline.contract_fingerprint(contract)
+  golden = json.loads(json.dumps(fp))  # deep copy
+  golden["state_donated"] = False
+  golden["collectives"][0]["count"] += 1
+  diffs = baseline.diff_fingerprints(golden, fp)
+  fields = {f for f, _, _ in diffs}
+  assert "state_donated" in fields
+  assert any(f.startswith("collectives[") and f.endswith(".count")
+             for f in fields)
+  assert len(diffs) == 2, diffs
+
+
+def test_missing_golden_is_a_diff(tmp_path, monkeypatch):
+  monkeypatch.setattr(baseline, "GOLDEN_DIR", str(tmp_path))
+  contract = contracts.extract_contract(_FAKE_HLO, config={})
+  diffs = baseline.check_against_golden("nope", contract)
+  assert diffs and diffs[0][0] == "<golden file>"
+  # write + re-check closes the loop
+  baseline.write_golden("nope", contract)
+  assert not baseline.check_against_golden("nope", contract)

@@ -380,7 +380,9 @@ def test_health_stats_bit_identical_to_stats_off(extra):
 
 # -- compiled-HLO: no extra collectives ---------------------------------------
 
-_ALL_REDUCE_DEF = re.compile(r"=\s+\S+\s+all-reduce(-start)?\(")
+# Single-sourced with the program-contract auditor (analysis/contracts.py).
+from kf_benchmarks_tpu.analysis.contracts import ALL_REDUCE_DEF \
+    as _ALL_REDUCE_DEF  # noqa: E402
 
 
 def test_health_stats_add_no_extra_collectives():
