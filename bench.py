@@ -102,6 +102,14 @@ def main():
       "compile_s": round(compile_s, 3) if compile_s is not None else None,
       "dispatch_overhead_s": (round(dispatch_s, 6)
                               if dispatch_s is not None else None),
+      # Mesh topology ("8" = 1-D replica mesh, "BxM" = the named 2-D
+      # mesh) + per-device optimizer-state HBM -- the pair that lets the
+      # BENCH_* trajectory A/B --shard_optimizer_state runs (~|state|/n
+      # expected) against replicated ones (~|state|). _CPU_FALLBACK
+      # semantics unchanged: both fields describe whatever mesh the run
+      # actually executed on.
+      "mesh_shape": stats.get("mesh_shape"),
+      "opt_state_bytes_per_device": stats.get("opt_state_bytes_per_device"),
   }
   # Run-health summary (telemetry.py): BENCH_*.json records whether the
   # run was HEALTHY, not just fast -- a throughput number next to

@@ -57,9 +57,15 @@ if "sweep" not in flags.param_specs:
       "device counts are powers of two up to --num_devices, algorithms "
       "from --sweep_specs, packed-vector sizes from --sweep_sizes.")
   flags.DEFINE_string(
-      "sweep_specs", "psum,rsag,hier",
+      "sweep_specs", "psum,rsag,hier,reduce_scatter,all_gather",
       "Comma-separated algorithms for --sweep (spec grammar "
-      "alg[#shards]; reference aliases accepted).")
+      "alg[#shards]; reference aliases accepted). The primitive names "
+      "'reduce_scatter' and 'all_gather' time the raw collective "
+      "instead of an all-reduce composition -- the sharded optimizer "
+      "path's exchange (--shard_optimizer_state meets gradients in a "
+      "reduce-scatter and returns params by all-gather), so its "
+      "collective mix A/Bs against the all-reduce rows of the same "
+      "n x size cell.")
   flags.DEFINE_string(
       "sweep_sizes", "256k,4m",
       "Comma-separated packed-vector byte sizes for --sweep "
@@ -199,6 +205,50 @@ def build_vector_step(mesh, spec_tuple, iters_per_step: int):
   return jax.jit(fn)
 
 
+# The primitive-collective rows of --sweep: the sharded optimizer
+# path's exchange (ops/sharded.py scatter_mean / gather_tree) timed in
+# isolation, beside the all-reduce compositions of the same cell.
+PRIMITIVE_COLLECTIVES = ("reduce_scatter", "all_gather")
+
+
+def build_primitive_step(mesh, collective: str, iters_per_step: int):
+  """One compiled step chaining ``iters_per_step`` raw reduce-scatters
+  (or all-gathers) of the packed vector. The collective's output shape
+  differs from its input (that is the point of the primitive), so the
+  chain dependency is a SCALAR read of the output folded back into the
+  next iteration's input -- one elementwise op, the same
+  cannot-elide/cannot-overlap role as build_vector_step's perturbation.
+  Wire bytes per iteration are (n-1)/n x the nominal cell size for
+  both primitives, directly comparable to the all-reduce rows."""
+  if collective not in PRIMITIVE_COLLECTIVES:
+    raise ValueError(f"unknown primitive collective {collective!r}")
+
+  def body(vec):
+    vec = vec[0]  # (1, elems) local shard -> the flat packed vector
+    n = lax.axis_size(REPLICA_AXIS)
+    for _ in range(iters_per_step):
+      if collective == "reduce_scatter":
+        # Tiled scatter needs a multiple of n; zero-pad like the real
+        # consumers do (ops/sharded.py _pad_flat, allreduce.py _rsag)
+        # -- non-power-of-two meshes and odd --sweep_sizes otherwise
+        # crash the default sweep.
+        pad = (-vec.shape[0]) % n
+        out = lax.psum_scatter(
+            jnp.pad(vec, (0, pad)) if pad else vec,
+            REPLICA_AXIS, tiled=True)
+      else:
+        # Gather of a 1/n shard re-assembles the full nominal size --
+        # the param leg of the sharded exchange.
+        out = lax.all_gather(vec[:vec.shape[0] // n], REPLICA_AXIS,
+                             tiled=True)
+      vec = vec + out.reshape(-1)[0] * jnp.asarray(1e-6, vec.dtype)
+    return vec[None]
+
+  fn = jax.shard_map(body, mesh=mesh, in_specs=P(REPLICA_AXIS),
+                     out_specs=P(REPLICA_AXIS))
+  return jax.jit(fn)
+
+
 def run_sweep(params) -> List[Dict[str, float]]:
   """The round-5 n x spec x size table from one command (PERF.md
   "All-reduce on a 4 MiB gradient vector" was hand-run per cell).
@@ -245,11 +295,15 @@ def run_sweep(params) -> List[Dict[str, float]]:
   for n in sweep_device_counts(len(devices)):
     mesh = mesh_lib.build_mesh(devices=devices[:n])
     for spec_name in spec_names:
-      tup = allreduce._parse_alg(spec_name)
-      if tup.alg == "hier":
-        tup = tup._replace(shards=max(tup.shards, 2))
-      step_k = build_vector_step(mesh, tup, iters)
-      step_2k = build_vector_step(mesh, tup, 2 * iters)
+      if spec_name in PRIMITIVE_COLLECTIVES:
+        step_k = build_primitive_step(mesh, spec_name, iters)
+        step_2k = build_primitive_step(mesh, spec_name, 2 * iters)
+      else:
+        tup = allreduce._parse_alg(spec_name)
+        if tup.alg == "hier":
+          tup = tup._replace(shards=max(tup.shards, 2))
+        step_k = build_vector_step(mesh, tup, iters)
+        step_2k = build_vector_step(mesh, tup, 2 * iters)
       for size in sizes:
         elems = max(size // itemsize, n)
         sharding = NamedSharding(mesh, P(REPLICA_AXIS))

@@ -169,6 +169,103 @@ def rule_wire_dtype(contract, tracer):
   return []
 
 
+def _sharded(contract) -> bool:
+  return bool(_cfg(contract, "shard_optimizer_state", False))
+
+
+def _group_sizes(replica_groups: str):
+  """Parse an HLO ``{{0,1},{2,3}}`` replica-groups string into the list
+  of group sizes (empty when the attribute was absent)."""
+  inner = replica_groups.strip().strip("{}")
+  if not inner:
+    return []
+  return [len([t for t in grp.split(",") if t.strip() != ""])
+          for grp in inner.split("},{")]
+
+
+def rule_sharded_collectives(contract, tracer):
+  """PR 6: a --shard_optimizer_state step meets its gradients in
+  reduce-scatter and returns params by all-gather -- NO full-gradient
+  all-reduce may remain (the ZeRO exchange, ops/sharded.py), each
+  reduce-scatter group spans the 'batch' axis (B data replicas) and
+  each all-gather group the whole mesh, and f32 training keeps f32
+  wires on both."""
+  if not _sharded(contract):
+    return []
+  out = []
+  rs = [c for c in contract.collectives
+        if c.kind == "reduce-scatter" and not c.scalar]
+  ag = [c for c in contract.collectives
+        if c.kind == "all-gather" and not c.scalar]
+  if not rs:
+    out.append("no reduce-scatter in the sharded step program -- the "
+               "gradient exchange fell back to something else")
+  if not ag:
+    out.append("no all-gather in the sharded step program -- updated "
+               "params are not being re-assembled from the shards")
+  grads = contract.gradient_collectives()
+  if grads:
+    out.append(f"{len(grads)} full-gradient all-reduce(s) in a sharded "
+               "step -- the reduce-scatter path is being duplicated "
+               "(or replaced) by the replicated exchange")
+  n = contract.aux.get("num_devices")
+  n_data = contract.aux.get("num_data_replicas") or n
+  if n:
+    bad_rs = [c for c in rs if c.replica_groups and
+              set(_group_sizes(c.replica_groups)) != {n_data}]
+    if bad_rs:
+      out.append(
+          f"{len(bad_rs)} reduce-scatter(s) with groups not spanning "
+          f"the {n_data}-replica 'batch' axis (e.g. "
+          f"{bad_rs[0].replica_groups}) -- the scattered mean would "
+          "meet the wrong contribution set")
+    bad_ag = [c for c in ag if c.replica_groups and
+              set(_group_sizes(c.replica_groups)) != {n}]
+    if bad_ag:
+      out.append(
+          f"{len(bad_ag)} all-gather(s) with groups not spanning the "
+          f"full {n}-device mesh (e.g. {bad_ag[0].replica_groups}) -- "
+          "devices would re-assemble partial parameter trees")
+  compact_16 = bool(_cfg(contract, "compact_gradient_transfer_f32")
+                    or _cfg(contract, "use_fp16"))
+  wires = contract.aux.get("requested_collective_wires") or {}
+  sharded_wires = set(wires.get("reduce-scatter", []) +
+                      wires.get("all-gather", []))
+  if not compact_16 and sharded_wires and sharded_wires != {"f32"}:
+    out.append(f"f32 wire expected on the sharded exchange (no 16-bit "
+               f"compaction engaged) but found {sorted(sharded_wires)}")
+  return out
+
+
+def rule_sharded_opt_bytes(contract, tracer):
+  """PR 6: per-device optimizer-state bytes under
+  --shard_optimizer_state are ~|state|/n of the replicated twin's (the
+  ZeRO partitioning bound; slack covers the per-leaf zero pad and the
+  per-shard scalar counts)."""
+  if not _sharded(contract) or tracer is None:
+    return []
+  per_device = contract.aux.get("opt_state_bytes_per_device")
+  n = contract.aux.get("num_devices")
+  if per_device is None or not n:
+    return []
+  twin_cfg = dict(contract.config)
+  twin_cfg.pop("shard_optimizer_state")
+  # A model axis is only valid WITH sharded state (validation.py), so
+  # the replicated twin must drop the mesh too -- the comparison is
+  # against the same device count's 1-D replicated state either way.
+  twin_cfg.pop("mesh_shape", None)
+  twin = tracer(twin_cfg, contract.program)
+  full = twin.aux.get("opt_state_bytes_per_device")
+  if full is None:
+    return []
+  bound = int(full / n * 1.05) + 4096
+  if per_device > bound:
+    return [f"per-device optimizer state {per_device} B exceeds the "
+            f"ZeRO bound ~|state|/n = {full}/{n} B (+pad slack "
+            f"{bound} B) -- state is leaking back to replicated"]
+  return []
+
+
 # -- program-shape invariants (every config) ----------------------------------
 
 def rule_no_host_transfer(contract, tracer):
@@ -211,19 +308,30 @@ def rule_single_optimizer_apply(contract, tracer):
 
 def rule_full_mesh_replica_groups(contract, tracer):
   """Replicated-family reductions span the full replica mesh as one
-  group -- a split group means a silent partial reduction."""
+  group -- a split group means a silent partial reduction. On a 2-D
+  sharded mesh with a model axis, the metric pmeans legitimately span
+  the BATCH axis only (M groups of B devices; model-axis peers hold
+  identical values), so groups of exactly num_data_replicas are also
+  admitted there."""
   if not _replicated_sync(contract):
     return []
   n = contract.aux.get("num_devices")
   if not n:
     return []
+  ok_sizes = {n}
+  n_data = contract.aux.get("num_data_replicas")
+  if _sharded(contract) and n_data:
+    ok_sizes.add(n_data)
   want = "{{" + ",".join(str(i) for i in range(n)) + "}}"
   bad = [c for c in contract.collectives
          if c.kind == "all-reduce" and c.replica_groups
-         and c.replica_groups != want]
+         and set(_group_sizes(c.replica_groups)) not in
+         [{s} for s in ok_sizes]]
   if bad:
+    alt = (f" or {n_data}-wide batch groups" if len(ok_sizes) > 1
+           else "")
     return [f"{len(bad)} all-reduce(s) with partial replica groups "
-            f"(want {want}, got e.g. {bad[0].replica_groups})"]
+            f"(want {want}{alt}, got e.g. {bad[0].replica_groups})"]
   return []
 
 
@@ -233,6 +341,8 @@ RULES: Dict[str, Callable] = {
     "no-btv-buffer": rule_no_btv_buffer,
     "health-no-extra-collective": rule_health_no_extra_collective,
     "wire-dtype": rule_wire_dtype,
+    "sharded-collectives": rule_sharded_collectives,
+    "sharded-opt-bytes": rule_sharded_opt_bytes,
     "no-host-transfer": rule_no_host_transfer,
     "state-donated": rule_state_donated,
     "single-optimizer-apply": rule_single_optimizer_apply,

@@ -48,6 +48,10 @@ def contract_fingerprint(contract: ProgramContract) -> Dict[str, Any]:
       # Lowered-level gradient wire dtypes (the TPU wire; see
       # contracts.requested_all_reduce_wires).
       "requested_grad_wires": contract.aux.get("requested_grad_wires"),
+      # Sharded-path collective wires (reduce-scatter/all-gather mix of
+      # --shard_optimizer_state programs; None elsewhere).
+      "requested_collective_wires": contract.aux.get(
+          "requested_collective_wires"),
   }
 
 

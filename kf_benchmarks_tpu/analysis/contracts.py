@@ -89,23 +89,42 @@ _ITEMSIZE = {"pred": 1, "s8": 1, "u8": 1, "bf16": 2, "f16": 2, "s16": 2,
              "u16": 2, "f32": 4, "s32": 4, "u32": 4, "c64": 8, "f64": 8,
              "s64": 8, "u64": 8, "c128": 16}
 
-# A stablehlo.all_reduce result type in LOWERED (pre-optimization) text:
-# "... }) : (tensor<4101097xbf16>) -> tensor<4101097xbf16>". The wire
-# dtype must be read here: XLA:CPU legalizes 16-bit collectives to f32
-# during compilation, so the COMPILED dump shows the backend's wire,
-# not the program's requested one (which is what the TPU runs).
-_STABLEHLO_ALL_REDUCE = re.compile(
-    r'"stablehlo\.all_reduce".*?-> tensor<([0-9a-z_]+)>', re.S)
-
-
-def requested_all_reduce_wires(lowered_text: str):
-  """[(dtype, elems), ...] of every all_reduce in a lowered module."""
+# A stablehlo collective's result type in LOWERED (pre-optimization)
+# text: "... }) : (tensor<4101097xbf16>) -> tensor<4101097xbf16>". The
+# wire dtype must be read here: XLA:CPU legalizes 16-bit collectives to
+# f32 during compilation, so the COMPILED dump shows the backend's
+# wire, not the program's requested one (which is what the TPU runs).
+def _stablehlo_result_types(lowered_text: str, op: str):
+  pat = re.compile(r'"stablehlo\.%s".*?-> tensor<([0-9a-z_]+)>' % op,
+                   re.S)
   out = []
-  for spec in _STABLEHLO_ALL_REDUCE.findall(lowered_text):
+  for spec in pat.findall(lowered_text):
     parts = spec.split("x")
     dtype = parts[-1]
     elems = math.prod(int(d) for d in parts[:-1]) if len(parts) > 1 else 1
     out.append((dtype, elems))
+  return out
+
+
+def requested_all_reduce_wires(lowered_text: str):
+  """[(dtype, elems), ...] of every all_reduce in a lowered module."""
+  return _stablehlo_result_types(lowered_text, "all_reduce")
+
+
+def requested_collective_wires(lowered_text: str):
+  """{kind: sorted wire dtypes of non-scalar ops} at the LOWERED level
+  for the sharded path's collective mix (reduce_scatter / all_gather /
+  all_reduce) -- read here for the same reason as
+  :func:`requested_all_reduce_wires`: XLA:CPU legalizes 16-bit
+  collectives to f32 while compiling, so the compiled dump shows the
+  backend's wire, not the program's requested (TPU) one."""
+  out = {}
+  for op in ("all_reduce", "reduce_scatter", "all_gather"):
+    dtypes = sorted({dtype for dtype, elems
+                     in _stablehlo_result_types(lowered_text, op)
+                     if elems > 1})
+    if dtypes:
+      out[op.replace("_", "-")] = dtypes
   return out
 
 
@@ -230,10 +249,13 @@ def trace_contract(overrides: Dict[str, Any],
   sample = jax.ShapeDtypeStruct(tuple(in_shapes[0]), in_dtypes[0])
   state_sds = jax.eval_shape(init_state, jax.random.PRNGKey(0), sample)
   n = bench.num_devices
-  gx = jax.ShapeDtypeStruct((in_shapes[0][0] * n,) + tuple(in_shapes[0][1:]),
-                            in_dtypes[0])
-  gy = jax.ShapeDtypeStruct((in_shapes[1][0] * n,) + tuple(in_shapes[1][1:]),
-                            in_dtypes[1])
+  # Global batch follows the DATA-parallel width (model-axis peers of a
+  # 2-D mesh re-compute the same shard; == n on 1-D meshes).
+  n_data = int(getattr(bench, "num_data_replicas", n))
+  gx = jax.ShapeDtypeStruct(
+      (in_shapes[0][0] * n_data,) + tuple(in_shapes[0][1:]), in_dtypes[0])
+  gy = jax.ShapeDtypeStruct(
+      (in_shapes[1][0] * n_data,) + tuple(in_shapes[1][1:]), in_dtypes[1])
   if program == "train_chunk":
     if train_chunk is None:
       raise ValueError("train_chunk requested but --steps_per_dispatch=1")
@@ -248,6 +270,7 @@ def trace_contract(overrides: Dict[str, Any],
   aux: Dict[str, Any] = {
       "model": bench.model.get_name(),
       "num_devices": n,
+      "num_data_replicas": n_data,
       "per_device_batch": int(in_shapes[0][0]),
       "health_stats": bool(bench.params.health_stats),
       # Gradient wire dtypes the PROGRAM requests (lowered level; the
@@ -257,6 +280,18 @@ def trace_contract(overrides: Dict[str, Any],
               lowered.as_text())
           if elems >= GRAD_MIN_ELEMS}),
   }
+  # --shard_optimizer_state contract inputs (audit.rule_sharded_*): the
+  # requested reduce-scatter/all-gather wire dtypes, and the per-device
+  # optimizer-state bytes read from the ABSTRACT state -- exactly what
+  # each device will hold, one row of every (n, k) shard stack.
+  if bool(getattr(bench.params, "shard_optimizer_state", False)):
+    aux["sharded_state"] = True
+    aux["requested_collective_wires"] = requested_collective_wires(
+        lowered.as_text())
+  # Shape/dtype-based, so the ONE accounting serves both the bench
+  # JSON field (concrete arrays) and this abstract state.
+  aux["opt_state_bytes_per_device"] = benchmark.opt_state_bytes_per_device(
+      state_sds.opt_state)
   # The (B, T, V) bound the fused-head LM contract is checked against:
   # the bytes of the logits tensor the program must NOT materialize.
   if bench.model.get_name() == "transformer_lm":
@@ -318,4 +353,24 @@ GOLDEN_CONFIGS: "OrderedDict[str, Dict[str, Any]]" = OrderedDict([
     # backward scan's while body.
     ("lm_overlap", dict(model="transformer_lm", batch_size=8,
                         overlap_gradient_reduction=True)),
+    # PR 6: ZeRO sharded optimizer state on the named 2-D mesh
+    # (--shard_optimizer_state resolves an 8x1 ('batch', 'model') mesh
+    # here): gradients meet in reduce-scatter, params return by
+    # all-gather, NO full-gradient all-reduce, per-device opt state
+    # ~|state|/n (audit.rule_sharded_collectives / _opt_bytes).
+    # (momentum, not the sgd default: sgd's only slot is a schedule
+    # count, which would leave the ZeRO memory bound vacuous.)
+    ("sharded_base", dict(model="trivial", batch_size=4,
+                          optimizer="momentum",
+                          shard_optimizer_state=True)),
+    # PR 6: composition with --num_grad_accum -- the microbatch scan
+    # still pays its reductions once per STEP, now as the scatter.
+    ("sharded_accum", dict(model="trivial", batch_size=4,
+                           num_grad_accum=4, optimizer="momentum",
+                           shard_optimizer_state=True)),
+    # PR 6: the scanned fused-head LM under sharded state -- the
+    # (B, T, V) bound and the sharded collective mix must hold at once.
+    ("lm_sharded", dict(model="transformer_lm", batch_size=8,
+                        optimizer="momentum",
+                        shard_optimizer_state=True)),
 ])

@@ -41,6 +41,26 @@ def log_fn(msg):
   log_util.log_fn(msg)
 
 
+def opt_state_bytes_per_device(opt_state) -> int:
+  """Per-device optimizer-state HBM of a stacked opt_state tree: every
+  leaf carries a leading stacked-replica (or shard-row) dim, so
+  per-device bytes are total bytes / leading dim -- ~|state| on the
+  replicated layout, ~|state|/n under --shard_optimizer_state (the
+  ZeRO partitioning claim, surfaced in bench.py's JSON line).
+
+  Shape/dtype-based, so it accounts concrete device arrays and the
+  auditor's ``jax.eval_shape`` ShapeDtypeStructs identically
+  (analysis/contracts.py trace_contract aux)."""
+  total = 0
+  for leaf in jax.tree.leaves(opt_state):
+    shape = tuple(leaf.shape)
+    lead = shape[0] if shape else 1
+    nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(
+        leaf.dtype).itemsize
+    total += nbytes // max(int(lead), 1)
+  return total
+
+
 def compute_eval_step_set(params, global_batch_size: int,
                           num_train_examples: int, num_batches: int,
                           start_step: int = 0, start_examples: int = 0):
@@ -304,11 +324,27 @@ class BenchmarkCNN:
           f"size {self.batch_size_per_device} (model default for "
           f"{self.model.get_name()}); pass a divisible --batch_size")
     self.num_devices = params.num_devices
-    self.batch_size = self.batch_size_per_device * self.num_devices
     # Multi-process (multi-host) runs multiply further (ref num_workers).
     self.num_workers = jax.process_count()
-    self.mesh = mesh_lib.build_mesh(self.num_devices, params.device)
+    # Mesh family: --mesh_shape / --shard_optimizer_state select the
+    # named 2-D ('batch', 'model') mesh (sharded alone resolves Nx1);
+    # everything else keeps the 1-D replica mesh. The GLOBAL batch
+    # follows the DATA-parallel width only: model-axis peers re-compute
+    # the same batch shard (train_step.py), so a 4x2 mesh feeds the
+    # global batch of 4 replicas, not 8.
+    if params.mesh_shape or params.shard_optimizer_state:
+      nb, nm = (validation.parse_mesh_shape(params.mesh_shape)
+                if params.mesh_shape else (self.num_devices, 1))
+      self.mesh = mesh_lib.build_mesh_2d(nb, nm, params.device)
+    else:
+      self.mesh = mesh_lib.build_mesh(self.num_devices, params.device)
+    self.num_data_replicas = mesh_lib.num_data_replicas(self.mesh)
+    self.batch_size = self.batch_size_per_device * self.num_data_replicas
     self.strategy = strategies.get_strategy(params)
+    # --shard_optimizer_state: checkpoints must save/restore the FULL
+    # stacked shard rows, not the v0 slice (checkpoint.py).
+    self._sharded_state = bool(getattr(self.strategy, "sharded_state",
+                                       False))
     # Training-health telemetry (telemetry.py): resolve the auto
     # default (--health_stats unset) against the strategy's reduction
     # semantics ONCE, so the step builder and the host-side recorder/
@@ -389,6 +425,11 @@ class BenchmarkCNN:
     log_fn("             %d per device" % self.batch_size_per_device)
     log_fn("Num batches: %d" % self.num_batches)
     log_fn("Num devices: %d (%s)" % (self.num_devices, p.device))
+    if mesh_lib.BATCH_AXIS in self.mesh.axis_names:
+      log_fn("Mesh:        %dx%d (batch x model)%s" % (
+          self.mesh.shape[mesh_lib.BATCH_AXIS],
+          self.mesh.shape[mesh_lib.MODEL_AXIS],
+          ", sharded optimizer state" if p.shard_optimizer_state else ""))
     log_fn("Data format: %s" % p.data_format)
     log_fn("Precision:   %s (params: %s)" % (
         jnp.dtype(self.compute_dtype).name,
@@ -414,7 +455,9 @@ class BenchmarkCNN:
     lr_fn = learning_rate.make_learning_rate_fn(
         p, self.model,
         self.batch_size_per_device * (
-            self.num_devices if self.strategy.cross_replica else 1),
+            # Effective batch = per-device x DATA-parallel width (model-
+            # axis peers add no examples); == num_devices on 1-D meshes.
+            self.num_data_replicas if self.strategy.cross_replica else 1),
         self.dataset.num_examples_per_epoch("train"), self.num_workers)
     tx = optimizers.get_optimizer(p, lr_fn)
     self._lr_fn = lr_fn
@@ -438,9 +481,11 @@ class BenchmarkCNN:
     if jnp.issubdtype(images.dtype, jnp.floating):
       images = images.astype(self.compute_dtype)
     # Labels may be a pytree (e.g. SSD's (boxes, classes, num_matched)).
-    # Tile covers THIS process's devices; put_batch assembles the global
-    # array from per-process shards under multi-process SPMD.
-    tile = lambda x: jnp.tile(x, (self.num_devices,) + (1,) * (x.ndim - 1))
+    # Tile covers THIS process's DATA replicas (model-axis peers read
+    # the same shard); put_batch assembles the global array from
+    # per-process shards under multi-process SPMD.
+    tile = lambda x: jnp.tile(
+        x, (self.num_data_replicas,) + (1,) * (x.ndim - 1))
     batch_sharding = mesh_lib.batch_sharding(self.mesh)
     return mesh_lib.put_batch(
         (tile(images), jax.tree.map(tile, labels)), batch_sharding)
@@ -660,7 +705,11 @@ class BenchmarkCNN:
     self.batch_size_per_device = batch_per_device
     self.model.set_batch_size(batch_per_device)
     self.batch_size = batch_per_device * num_devices
+    # Elastic is 1-D replica-mesh only (--shard_optimizer_state is
+    # rejected with --elastic in validation.py: resharding 1/n state
+    # shards across a resize is the checkpointed-rescale leg).
     self.mesh = mesh_lib.build_mesh(num_devices, self.params.device)
+    self.num_data_replicas = num_devices
     # Rebuild the strategy: its reducer may capture topology-derived
     # constants sized to the OLD axis (hierarchical_copy groups,
     # planner replica hints), which would mis-permute on the new mesh.
@@ -715,7 +764,9 @@ class BenchmarkCNN:
     if p.train_dir:
       try:
         path, ckpt_step = checkpoint.latest_checkpoint(p.train_dir)
-        state = checkpoint.restore_state(state, checkpoint.load_checkpoint(path))
+        state = checkpoint.restore_state(
+            state, checkpoint.load_checkpoint(path),
+            sharded_opt_state=self._sharded_state)
         log_fn(f"Restored checkpoint at global step {ckpt_step}")
         resumed = True
       except checkpoint.CheckpointNotFoundException:
@@ -1137,7 +1188,8 @@ class BenchmarkCNN:
           # Periodic checkpoint by steps (ref: benchmark_cnn.py:2304-2309)
           # or seconds (ref: Supervisor save_model_secs, :2137).
           checkpoint.save_checkpoint(p.train_dir, state,
-                                     p.max_ckpts_to_keep)
+                                     p.max_ckpts_to_keep,
+                                     sharded_opt_state=self._sharded_state)
           last_save_time = time.time()
         if eval_due:
           # Mid-training eval + early stop (ref: benchmark_cnn.py:2310-2324).
@@ -1214,7 +1266,8 @@ class BenchmarkCNN:
               for done in pipe.flush():
                 _handle(done)
               checkpoint.save_checkpoint(p.train_dir, state,
-                                         p.max_ckpts_to_keep)
+                                         p.max_ckpts_to_keep,
+                                         sharded_opt_state=self._sharded_state)
               log_fn("Elastic restart at step %d: workers %d -> %d "
                      "(checkpoint + re-exec under the launcher)" % (
                          i, max(self.num_workers, 1), restart_np))
@@ -1340,7 +1393,8 @@ class BenchmarkCNN:
                     "watchdog_stalls": health_summary["watchdog_stalls"]})
     # Final checkpoint (ref: benchmark_cnn.py:2374-2378).
     if p.train_dir:
-      checkpoint.save_checkpoint(p.train_dir, state, p.max_ckpts_to_keep)
+      checkpoint.save_checkpoint(p.train_dir, state, p.max_ckpts_to_keep,
+                                 sharded_opt_state=self._sharded_state)
     if p.sync_on_finish:
       kungfu.run_barrier()
     # (ref stats dict: benchmark_cnn.py:2383-2391)
@@ -1371,6 +1425,14 @@ class BenchmarkCNN:
         # off): max grad norm, nonfinite_steps, loss_scale_final,
         # watchdog_stalls, anomaly_dumps (telemetry.py).
         "health": health_summary,
+        # Mesh topology + per-device optimizer-state HBM: "8" on the
+        # 1-D replica mesh, "BxM" on the named 2-D mesh; the bytes
+        # field is what --shard_optimizer_state divides by ~n
+        # (bench.py forwards both into its one-line JSON).
+        "mesh_shape": "x".join(
+            str(int(s)) for s in self.mesh.devices.shape),
+        "opt_state_bytes_per_device": opt_state_bytes_per_device(
+            state.opt_state),
         "state": state,
     }
 

@@ -68,28 +68,43 @@ def _replica0_local(x):
   return np.asarray(x[0])
 
 
-def savable_state(state) -> dict:
+def savable_state(state, sharded_opt_state: bool = False) -> dict:
   """Host-side, mode-invariant snapshot: replica-0 slice of the stacked
-  arrays + replicated scalars (ref: variable_mgr savable_variables)."""
+  arrays + replicated scalars (ref: variable_mgr savable_variables).
+
+  ``sharded_opt_state=True`` (--shard_optimizer_state runs): the
+  opt_state rows are per-device 1/n SHARDS, not copies, so the v0-only
+  rule would drop (n-1)/n of the state -- the FULL stacked ``(n, k)``
+  arrays are saved instead and the snapshot is marked with
+  ``opt_state_layout`` so restore_state re-shards rather than
+  broadcasts. Model variables (params/batch_stats) stay v0-sliced and
+  mode-invariant, so eval / restore_opt_state=False interop across
+  modes is preserved; validation.py keeps sharded runs single-process,
+  which is what makes every row chief-addressable here."""
   slice0 = lambda t: jax.tree.map(_replica0_local, t)
-  return {
+  snap = {
       "step": int(state.step),
       "params": slice0(state.params),
-      "opt_state": slice0(state.opt_state),
+      "opt_state": (jax.tree.map(np.asarray, state.opt_state)
+                    if sharded_opt_state else slice0(state.opt_state)),
       "batch_stats": slice0(state.batch_stats),
       "loss_scale": float(state.loss_scale),
       "loss_scale_normal_steps": int(state.loss_scale_normal_steps),
   }
+  if sharded_opt_state:
+    snap["opt_state_layout"] = "sharded"
+  return snap
 
 
-def save_checkpoint(train_dir: str, state, max_to_keep: int = 5) -> str:
+def save_checkpoint(train_dir: str, state, max_to_keep: int = 5,
+                    sharded_opt_state: bool = False) -> str:
   """Write a checkpoint; prune beyond ``max_to_keep``
   (ref: --max_ckpts_to_keep, benchmark_cnn.py:606-608). No-op on
   non-chief processes."""
   if not is_chief():
     return ""
   os.makedirs(train_dir, exist_ok=True)
-  snap = savable_state(state)
+  snap = savable_state(state, sharded_opt_state=sharded_opt_state)
   step = snap["step"]
   fname = f"model.ckpt-{step}.msgpack"
   path = os.path.join(train_dir, fname)
@@ -167,7 +182,8 @@ def _reseed_staged(buffers, params):
   return buffers
 
 
-def restore_state(state, snapshot: dict, restore_opt_state: bool = True):
+def restore_state(state, snapshot: dict, restore_opt_state: bool = True,
+                  sharded_opt_state: bool = False):
   """Rebuild a stacked device TrainState from a host snapshot: replica-0
   values are broadcast to every replica (the restore-side analog of the
   reference's post-init v0->v* copy, variable_mgr.py:342-356).
@@ -177,13 +193,34 @@ def restore_state(state, snapshot: dict, restore_opt_state: bool = True):
   so its Saver restore never touches them, ref benchmark_cnn.py:
   1829-1862): an eval process must be able to read a checkpoint written
   under ANY optimizer, not just the one its own flags happen to default
-  to."""
+  to.
+
+  Snapshots marked ``opt_state_layout == 'sharded'`` carry the FULL
+  stacked shard arrays (see savable_state); they restore only into a
+  state whose opt_state has the same sharded layout, and a layout
+  mismatch in either direction raises (re-slicing 1/n flat shards into
+  the other layout silently would corrupt the optimizer state)."""
+  snap_sharded = snapshot.get("opt_state_layout") == "sharded"
+  if restore_opt_state and snap_sharded != sharded_opt_state:
+    raise ValueError(
+        f"checkpoint opt_state layout is "
+        f"{'sharded' if snap_sharded else 'replicated'} but the run's "
+        f"is {'sharded' if sharded_opt_state else 'replicated'}: "
+        "--shard_optimizer_state checkpoints only resume sharded runs "
+        "of the same topology (pass restore_opt_state=False to warm-"
+        "start model variables only)")
   params = _restack(state.params, snapshot["params"])
+  if restore_opt_state:
+    if snap_sharded:
+      new_opt = _reshard(state.opt_state, snapshot["opt_state"])
+    else:
+      new_opt = _restack(state.opt_state, snapshot["opt_state"])
+  else:
+    new_opt = state.opt_state
   return state.replace(
       step=jnp.asarray(snapshot["step"], jnp.int32),
       params=params,
-      opt_state=(_restack(state.opt_state, snapshot["opt_state"])
-                 if restore_opt_state else state.opt_state),
+      opt_state=new_opt,
       batch_stats=_restack(state.batch_stats, snapshot["batch_stats"]),
       loss_scale=jnp.asarray(snapshot["loss_scale"], jnp.float32),
       loss_scale_normal_steps=jnp.asarray(
@@ -248,6 +285,26 @@ def restore_backbone(state, path: str):
       batch_stats=merge(state.batch_stats, snapshot.get("batch_stats")),
       buffers=_reseed_staged(state.buffers, params))
   return new_state, restored[0]
+
+
+def _reshard(template, host_tree):
+  """Restore a FULL stacked shard tree (savable_state sharded layout):
+  every saved ``(n, k)`` array lands whole -- row i is device i's shard
+  again -- instead of the v0 broadcast. Shape equality against the live
+  template is the topology check: a shard tree saved at a different n
+  cannot be resliced here (the checkpointed-rescale leg, ROADMAP)."""
+  host_state = serialization.from_state_dict(
+      jax.tree.map(np.asarray, template), host_tree)
+
+  def place(t, h):
+    h = np.asarray(h)
+    if tuple(h.shape) != tuple(t.shape):
+      raise ValueError(
+          f"sharded opt_state leaf shape {h.shape} != live {t.shape}: "
+          "the checkpoint was written at a different shard count")
+    return jnp.asarray(h, t.dtype)
+
+  return jax.tree.map(place, template, host_state)
 
 
 def _restack(template, host_tree):

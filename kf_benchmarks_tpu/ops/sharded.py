@@ -1,0 +1,114 @@
+"""ZeRO-style sharded optimizer state: scatter / shard / gather helpers.
+
+The TPU reformulation of the reference's central variable placement
+(parameter_server / distributed_replicated variable placement,
+ref: variable_mgr.py:201-243, :704-831; SURVEY 5.8): instead of a host
+process owning the "server copy" of the variables and optimizer slots,
+each device owns a flat 1/n shard of them (Rajbhandari et al., ZeRO),
+and the collectives the graph-mode PS expressed as send/recv become
+compiler-scheduled reduce-scatter / all-gather on the named 2-D
+``('batch', 'model')`` mesh (parallel/mesh.py build_mesh_2d) -- the
+GSPMD pattern (Xu et al. 2021).
+
+Layout contract (everything here depends on it):
+
+* A leaf of ``size`` elements pads with zeros to ``n * k`` where
+  ``k = ceil(size / n)`` and ``n`` is the TOTAL device count; flat
+  block ``i`` belongs to the device with flat shard index
+  ``i = axis_index('batch') * M + axis_index('model')`` -- row-major
+  over the mesh, the order a tiled ``all_gather(('batch', 'model'))``
+  concatenates in.
+* The gradient mean reduce-scatters over the ``'batch'`` axis ONLY
+  (model-axis peers hold the same batch shard and the same fold_in rng,
+  so their local gradients are identical by construction): the
+  summation meets the same ``B`` distinct contributions in the same
+  group order as the replicated path's all-reduce, which is what makes
+  the scattered mean BIT-IDENTICAL to the ``pmean`` it replaces
+  (pinned in tests/test_sharded_optimizer.py). The model-axis split of
+  the batch-block is then a free local slice.
+* Optimizer updates on the zero-padded tail are harmless: gradients
+  there are exactly zero (pad-in, sum-of-zeros out), every stock
+  optimizer maps (g=0, state=0) to update 0, and the tail is dropped at
+  gather time regardless.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kf_benchmarks_tpu.parallel.mesh import BATCH_AXIS, MODEL_AXIS
+
+
+def shard_len(size: int, num_shards: int) -> int:
+  """Per-device flat shard length: ceil(size / num_shards)."""
+  return -(-size // num_shards)
+
+
+def _pad_flat(x, num_shards: int):
+  k = shard_len(x.size, num_shards)
+  flat = jnp.ravel(x)
+  return jnp.pad(flat, (0, num_shards * k - x.size)), k
+
+
+def stacked_shards(tree, num_shards: int):
+  """Full tree -> host-global stacked shard tree: each leaf flattened,
+  zero-padded and reshaped ``(n, k)`` so row ``i`` is device ``i``'s
+  shard. Global memory stays ~|leaf| (one padded copy, no n-fold
+  stacking); sharding row 0 over the mesh axes puts exactly one row on
+  each device. This is the layout ``TrainState.opt_state`` carries
+  under --shard_optimizer_state (train_step.py)."""
+  def f(x):
+    flat, k = _pad_flat(x, num_shards)
+    return flat.reshape(num_shards, k)
+  return jax.tree.map(f, tree)
+
+
+def scatter_mean(grads, batch_axis: str = BATCH_AXIS,
+                 model_axis: str = MODEL_AXIS):
+  """Local full-gradient tree -> this device's flat mean-shard.
+
+  Reduce-scatter of the batch-axis mean (wire: ``(B-1)/B * |grads|``
+  per device instead of the all-reduce's ``2(n-1)/n``), then the free
+  model-axis sub-slice. Runs inside the shard_mapped step body."""
+  nb = lax.axis_size(batch_axis)
+  nm = lax.axis_size(model_axis)
+  n = nb * nm
+  mi = lax.axis_index(model_axis)
+
+  def f(x):
+    flat, k = _pad_flat(x, n)
+    # Each batch group's scatter meets B distinct contributions in
+    # group order -- the same association as the replicated pmean.
+    block = lax.psum_scatter(flat, batch_axis, tiled=True) / nb
+    return lax.dynamic_slice(block, (mi * k,), (k,))
+  return jax.tree.map(f, grads)
+
+
+def local_shards(tree, batch_axis: str = BATCH_AXIS,
+                 model_axis: str = MODEL_AXIS):
+  """Full (replica-identical) tree -> this device's flat shard by local
+  slice -- no collective: every device already holds the whole value."""
+  nb = lax.axis_size(batch_axis)
+  nm = lax.axis_size(model_axis)
+  n = nb * nm
+  idx = lax.axis_index(batch_axis) * nm + lax.axis_index(model_axis)
+
+  def f(x):
+    flat, k = _pad_flat(x, n)
+    return lax.dynamic_slice(flat, (idx * k,), (k,))
+  return jax.tree.map(f, tree)
+
+
+def gather_tree(shards, template, batch_axis: str = BATCH_AXIS,
+                model_axis: str = MODEL_AXIS):
+  """Flat shard tree -> full tree: tiled all-gather over the combined
+  ``(batch, model)`` axes (row-major concatenation matches the
+  scatter/slice block order), drop the pad, restore leaf shapes."""
+  axes = (batch_axis, model_axis)
+
+  def f(s, t):
+    full = lax.all_gather(s, axes, tiled=True)
+    return full[:t.size].reshape(t.shape).astype(t.dtype)
+  return jax.tree.map(f, shards, template)

@@ -106,6 +106,32 @@ class ParameterServerStrategy(ReplicatedStrategy):
   name = "parameter_server"
 
 
+class ShardedOptimizerStrategy(ReplicatedStrategy):
+  """ZeRO/FSDP sharded optimizer state (--shard_optimizer_state) on the
+  named 2-D ('batch', 'model') mesh: the faithful TPU rendering of the
+  reference's central variable placement (the PS "server copy" of
+  variables + optimizer slots, ref: variable_mgr.py:201-243; across
+  hosts :704-831; SURVEY 5.8) -- the server is the 1/n state shard each
+  device owns, gradients meet in a reduce-scatter instead of the
+  all-reduce, and updated params return by all-gather.
+
+  The hooks here are markers only: the scatter/apply/gather mechanics
+  live in train_step.py's sharded branch + ops/sharded.py (the step
+  owns gradient packing and the optimizer apply, exactly as it owns
+  them for sequential_apply). ``sync_batch_stats`` stays the inherited
+  pmean -- BN statistics remain replicated; only optimizer state
+  shards."""
+
+  name = "parameter_server(sharded)"
+  cross_replica = True
+  sharded_state = True
+
+  def reduce_gradients(self, grads, axis_name=REPLICA_AXIS):
+    raise NotImplementedError(
+        "sharded-state gradient reduction is the step's reduce-scatter "
+        "(train_step.py + ops/sharded.py), not a strategy hook")
+
+
 class AsyncParameterServerStrategy(ReplicatedStrategy):
   """Async PS (--cross_replica_sync=false, ref: benchmark_cnn.py:520-522).
 
@@ -227,6 +253,11 @@ class KungFuStrategy(Strategy):
 def get_strategy(params) -> Strategy:
   """Strategy selection (ref: benchmark_cnn.py:1481-1524)."""
   vu = params.variable_update
+  if getattr(params, "shard_optimizer_state", False):
+    # validation.validate_cross_flags restricts this to the synchronous
+    # replicated/parameter_server family; the sharded strategy subsumes
+    # both (the state shard IS the central placement).
+    return ShardedOptimizerStrategy(params)
   if vu == "independent":
     return IndependentStrategy(params)
   if vu == "kungfu":

@@ -38,10 +38,19 @@ from kf_benchmarks_tpu.parallel import expert as ep_lib
 from kf_benchmarks_tpu.parallel import pipeline as pp_lib
 from kf_benchmarks_tpu.parallel import sequence as seq_lib
 from kf_benchmarks_tpu.parallel import tensor as tp_lib
-from kf_benchmarks_tpu.parallel.mesh import REPLICA_AXIS
+from kf_benchmarks_tpu.parallel.mesh import BATCH_AXIS, REPLICA_AXIS
 
 SEQ_AXIS = seq_lib.SEQ_AXIS
 TENSOR_AXIS = tp_lib.TENSOR_AXIS
+
+
+def _data_axis(mesh: Mesh) -> str:
+  """The data-parallel axis name of a composed-trainer mesh: 'batch' on
+  the shared named-mesh family (compose_on_model_axis -- the same axis
+  system as parallel/mesh.py build_mesh_2d), 'replica' on the legacy
+  3-D/4-D grids. Axis NAMES carry no numerics: the two families produce
+  bit-identical programs (tests/test_transformer_parallel.py)."""
+  return BATCH_AXIS if BATCH_AXIS in mesh.axis_names else REPLICA_AXIS
 
 
 def init_params(key, *, vocab: int, d_model: int, n_layers: int,
@@ -94,18 +103,20 @@ def init_params(key, *, vocab: int, d_model: int, n_layers: int,
   return params
 
 
-def param_specs(params) -> Dict[str, Any]:
+def param_specs(params, data_axis: str = REPLICA_AXIS) -> Dict[str, Any]:
   """PartitionSpecs: tensor-sharded leaves on TENSOR_AXIS (heads for
   attention, features for the dense MLP); MoE expert stacks sharded on
-  REPLICA_AXIS (the expert axis); everything else replicated."""
+  the DATA axis (the expert axis -- experts live where the tokens are;
+  'batch' on compose_on_model_axis meshes); everything else
+  replicated."""
   dense = {
       "w1": P(None, TENSOR_AXIS), "b1": P(TENSOR_AXIS),
       "w2": P(TENSOR_AXIS, None), "b2": P(),
   }
   moe = {
       "gate_w": P(),
-      "ew1": P(REPLICA_AXIS), "eb1": P(REPLICA_AXIS),
-      "ew2": P(REPLICA_AXIS), "eb2": P(REPLICA_AXIS),
+      "ew1": P(data_axis), "eb1": P(data_axis),
+      "ew2": P(data_axis), "eb2": P(data_axis),
   }
   blocks = []
   for bp in params["blocks"]:
@@ -458,6 +469,25 @@ def build_mesh(n_replica: int, n_seq: int, n_tensor: int,
                     (REPLICA_AXIS, SEQ_AXIS, TENSOR_AXIS), devices)
 
 
+def compose_on_model_axis(n_batch: int, n_seq: int, n_tensor: int,
+                          devices=None) -> Mesh:
+  """The composed trainer on the SHARED axis system of the named 2-D
+  mesh (parallel/mesh.py build_mesh_2d): the 'model' axis of a
+  ``n_batch x (n_seq * n_tensor)`` 2-D mesh refined into its seq x
+  tensor factors -- ``('batch', 'seq', 'tensor')``, same device order
+  (row-major), same data axis name the core train step uses. One axis
+  system for every parallelism family: collectives over
+  ``('seq', 'tensor')`` are collectives over the 2-D family's 'model'
+  axis, and the data-parallel legs (batch sharding, gradient pmeans)
+  ride 'batch' exactly as train_step.py's sharded branch does --
+  instead of the bespoke 'replica'-named wiring of :func:`build_mesh`.
+  make_train_step detects the family from the axis names; programs are
+  bit-identical across the two namings
+  (tests/test_transformer_parallel.py)."""
+  return _grid_mesh((n_batch, n_seq, n_tensor),
+                    (BATCH_AXIS, SEQ_AXIS, TENSOR_AXIS), devices)
+
+
 def make_train_step(mesh: Mesh, params_template, learning_rate: float,
                     moe_capacity=None, moe_aux_weight: float = 0.01,
                     sp_layout: str = "contiguous",
@@ -465,8 +495,9 @@ def make_train_step(mesh: Mesh, params_template, learning_rate: float,
                     remat_policy=None,
                     overlap_grad_reduce: bool = False):
   """Jitted SGD train step over GLOBAL (params, tokens, labels):
-  tokens/labels (batch, seq) in NORMAL order, sharded (replica, seq);
-  params per param_specs. MoE blocks (if any in the template) add
+  tokens/labels (batch, seq) in NORMAL order, sharded (data, seq) --
+  the data axis is 'batch' on compose_on_model_axis meshes, 'replica'
+  on legacy build_mesh grids; params per param_specs. MoE blocks (if any in the template) add
   expert parallelism over the replica axis and fold the Switch aux
   loss in at ``moe_aux_weight``. sp_layout='zigzag' permutes the data
   into sequence.zigzag_order at the jit boundary and runs the
@@ -497,6 +528,7 @@ def make_train_step(mesh: Mesh, params_template, learning_rate: float,
         "overlap_grad_reduce=True requires scan_layers=True: the hooks "
         "live in the scanned block body (an unscanned stack already "
         "exposes every layer's reduction to the scheduler separately)")
+  data_axis = _data_axis(mesh)
   if scan_layers:
     if isinstance(params_template["blocks"], (list, tuple)):
       raise ValueError(
@@ -504,9 +536,9 @@ def make_train_step(mesh: Mesh, params_template, learning_rate: float,
           "(leading layer axis), not the per-layer block list")
     specs = stacked_param_specs()
   else:
-    specs = param_specs(params_template)
-  data_spec = P(REPLICA_AXIS, SEQ_AXIS)
-  n_data = mesh.shape[REPLICA_AXIS] * mesh.shape[SEQ_AXIS]
+    specs = param_specs(params_template, data_axis=data_axis)
+  data_spec = P(data_axis, SEQ_AXIS)
+  n_data = mesh.shape[data_axis] * mesh.shape[SEQ_AXIS]
   n_seq = mesh.shape[SEQ_AXIS]
 
   def body(params, tokens, labels):
@@ -515,7 +547,8 @@ def make_train_step(mesh: Mesh, params_template, learning_rate: float,
           p, tokens, moe_capacity=moe_capacity, sp_layout=sp_layout,
           attn_inner_block=attn_inner_block,
           remat_policy=remat_policy,
-          grad_reduce_axes=((REPLICA_AXIS, SEQ_AXIS)
+          expert_axis=data_axis,
+          grad_reduce_axes=((data_axis, SEQ_AXIS)
                             if overlap_grad_reduce else None))
       return (_loss_from_logits(logits, labels)
               + moe_aux_weight * moe_aux)
@@ -523,7 +556,7 @@ def make_train_step(mesh: Mesh, params_template, learning_rate: float,
     loss, grads = jax.value_and_grad(local_loss)(params)
     # Token mean over the whole global batch: every shard holds the
     # same token count, so the pmean of shard means is the global mean.
-    loss = lax.pmean(loss, (REPLICA_AXIS, SEQ_AXIS))
+    loss = lax.pmean(loss, (data_axis, SEQ_AXIS))
     # shard_map's vma-aware autodiff has already psum-ed each grad over
     # every axis its parameter is unvarying on (the transpose of the
     # implicit broadcast), so each leaf holds the SUM of the per-data-

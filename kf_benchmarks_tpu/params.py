@@ -124,6 +124,33 @@ flags.DEFINE_boolean("fp16_enable_auto_loss_scale", False,
                      "Auto loss-scaling state machine (ref :486-490).")
 flags.DEFINE_integer("fp16_inc_loss_scale_every_n", 1000,
                      "Double loss scale after N clean steps (ref :491-495).")
+flags.DEFINE_string("mesh_shape", None,
+                    "Named 2-D device mesh 'BxM' (e.g. 8x1, 4x2): B = "
+                    "'batch' axis (data parallelism; global batch = B x "
+                    "per-device batch), M = 'model' axis (state-sharding "
+                    "/ tensor dimension; the composed LM trainer refines "
+                    "it into seq x tensor, parallel/transformer.py). "
+                    "B*M must equal --num_devices; M > 1 requires "
+                    "--shard_optimizer_state (its only consumer in the "
+                    "core step). Unset = the 1-D replica mesh "
+                    "(--shard_optimizer_state alone resolves to Nx1). "
+                    "The GSPMD named-mesh idiom (Xu et al. 2021).")
+flags.DEFINE_boolean("shard_optimizer_state", False,
+                     "ZeRO-shard optimizer state over the whole "
+                     "('batch', 'model') mesh (Rajbhandari et al.): "
+                     "gradients meet in a reduce-scatter of the batch "
+                     "mean (bit-identical to the replicated pmean at "
+                     "f32), the optimizer applies on each device's 1/n "
+                     "flat state shard only, and updated params "
+                     "all-gather for the next forward -- per-device "
+                     "optimizer HBM drops to ~|state|/n and gradient "
+                     "wire bytes to (B-1)/B + (n-1)/n of |grads| (the "
+                     "TPU analog of the reference's central variable "
+                     "placement, variable_mgr.py:201-243; ops/"
+                     "sharded.py). Synchronous replicated/"
+                     "parameter_server family only; composes with "
+                     "--steps_per_dispatch and --num_grad_accum; "
+                     "exclusions in validation.py.")
 flags.DEFINE_enum("variable_update", "replicated",
                   ("independent", "parameter_server", "replicated",
                    "distributed_replicated", "distributed_all_reduce",

@@ -192,6 +192,22 @@ def resolve_health_stats(params, strategy=None):
                                                False):
     # Training-only: there is no gradient tree to measure.
     return False, None
+  if (getattr(params, "shard_optimizer_state", False) or
+      (strategy is not None and getattr(strategy, "sharded_state",
+                                        False))):
+    # Sharded-state steps apply the optimizer on 1/n flat shards
+    # (train_step.py + ops/sharded.py): the full update tree the stats
+    # read never materializes. Explicit --health_stats is rejected up
+    # front (validation.py); auto resolves off with a note when a sink
+    # asked for telemetry, quietly otherwise.
+    if getattr(params, "train_dir", None) or getattr(
+        params, "benchmark_log_dir", None):
+      return False, (
+          "health_stats: --shard_optimizer_state applies the optimizer "
+          "on per-device state shards; the full-tree in-step stats "
+          "(and with them the flight recorder/watchdog session) are "
+          "disabled")
+    return False, None
   if strategy is not None:
     cross = bool(getattr(strategy, "cross_replica", False))
   else:

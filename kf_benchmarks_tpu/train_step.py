@@ -5,7 +5,11 @@ graph build + per-tower loop + sess.run (ref: benchmark_cnn.py:2619-2731
 _build_model, :2958-3209 add_forward_pass_and_gradients, :786-884
 benchmark_one_step). Design:
 
-* One SPMD program over a jax.sharding.Mesh with a 'replica' axis.
+* One SPMD program over a jax.sharding.Mesh: the 1-D 'replica' mesh for
+  the replicated/gossip families, or the named 2-D ('batch', 'model')
+  mesh (parallel/mesh.py build_mesh_2d) behind --mesh_shape /
+  --shard_optimizer_state, where the batch shards over 'batch' and the
+  ZeRO state shards span both axes (ops/sharded.py).
 * Per-replica state convention: every TrainState leaf carries a leading
   replica dimension sharded P('replica') -- the exact analog of the
   reference's per-GPU variable copies (v0..vN scopes,
@@ -38,7 +42,10 @@ import optax
 from kf_benchmarks_tpu import elastic as elastic_lib
 from kf_benchmarks_tpu import telemetry as telemetry_lib
 from kf_benchmarks_tpu.ops import overlap as overlap_lib
-from kf_benchmarks_tpu.parallel.mesh import REPLICA_AXIS
+from kf_benchmarks_tpu.ops import sharded as sharded_lib
+from kf_benchmarks_tpu.parallel import mesh as mesh_lib
+from kf_benchmarks_tpu.parallel.mesh import (BATCH_AXIS, MODEL_AXIS,
+                                             REPLICA_AXIS)
 
 
 @flax.struct.dataclass
@@ -140,6 +147,25 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
   program).
   """
   num_replicas = mesh.devices.size
+  # Axis system. 1-D ('replica',) meshes keep the exact legacy program
+  # (every golden contract is pinned against it); the named 2-D
+  # ('batch', 'model') mesh behind --mesh_shape/--shard_optimizer_state
+  # shards the batch over 'batch' only (model-axis peers re-compute the
+  # same shard) while the stacked state and the metric pmeans span both
+  # axes.
+  two_d = BATCH_AXIS in mesh.axis_names
+  axis_data = BATCH_AXIS if two_d else REPLICA_AXIS
+  axis_all = mesh_lib.state_axes(mesh) if two_d else REPLICA_AXIS
+  # --shard_optimizer_state: the strategy is the marker; the mechanics
+  # (reduce-scatter mean, shard apply, param all-gather) live below +
+  # ops/sharded.py. Requires the 2-D mesh (benchmark.py builds Nx1 when
+  # --mesh_shape is unset).
+  sharded_state = bool(getattr(strategy, "sharded_state", False))
+  if sharded_state and not two_d:
+    raise ValueError(
+        "--shard_optimizer_state requires the named 2-D ('batch', "
+        "'model') mesh (parallel/mesh.py build_mesh_2d); got axes "
+        f"{mesh.axis_names}")
   weight_decay = params.weight_decay or 0.0
   # Loss-scale resolution (ref: benchmark_cnn.py:471-480 "None = model
   # default"): float16 compute defaults to the model's scale (128);
@@ -159,9 +185,9 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
   inc_every_n = params.fp16_inc_loss_scale_every_n
 
   state_specs = TrainState(
-      step=P(), params=P(REPLICA_AXIS), opt_state=P(REPLICA_AXIS),
-      batch_stats=P(REPLICA_AXIS), loss_scale=P(),
-      loss_scale_normal_steps=P(), rng=P(), buffers=P(REPLICA_AXIS))
+      step=P(), params=P(axis_all), opt_state=P(axis_all),
+      batch_stats=P(axis_all), loss_scale=P(),
+      loss_scale_normal_steps=P(), rng=P(), buffers=P(axis_all))
   staged_vars = bool(getattr(params, "staged_vars", False))
   relaxed = getattr(params, "variable_consistency", "strong") == "relaxed"
   steps_per_dispatch = int(
@@ -194,8 +220,13 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
   # (sequential_apply has no single optimizer-update tree to measure;
   # async PS is already health-rejected by validation/resolve -- this
   # keeps direct make_step_fns callers safe too.)
+  # (sharded state never reaches here with health on -- validation.py
+  # rejects the pair and resolve_health_stats auto-disables -- but the
+  # builder re-guards for direct callers: the stats read the full
+  # update tree, which the shard apply never materializes.)
   health_stats = (bool(getattr(params, "health_stats", None)) and
-                  not getattr(strategy, "sequential_apply", False))
+                  not getattr(strategy, "sequential_apply", False) and
+                  not sharded_state)
   # Top-level param-tree keys whose gradients the MODULE already
   # reduces in-backward (e.g. transformer_lm's scanned 'blocks' stack
   # hooks per layer inside the nn.scan); the step-level buckets skip
@@ -225,12 +256,22 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
     variables = module.init({"params": rng, "dropout": rng}, sample_images)
     model_params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
-    opt_state = tx.init(model_params)
+    if sharded_state:
+      # Per-shard optimizer state: vmap tx.init over the stacked flat
+      # param shards (ops/sharded.py layout), so every opt-state leaf
+      # comes out (n, k) with row i = device i's shard -- global bytes
+      # ~|state| instead of the replicated stack's n * |state|.
+      opt_state = jax.vmap(tx.init)(
+          sharded_lib.stacked_shards(model_params, num_replicas))
+    else:
+      opt_state = tx.init(model_params)
     return model_params, opt_state, batch_stats
 
   def init_state(rng, sample_images):
     """Builds the stacked per-replica TrainState (identical init on every
-    replica == the reference's post-init broadcast, variable_mgr.py:342-356)."""
+    replica == the reference's post-init broadcast, variable_mgr.py:342-356).
+    Under --shard_optimizer_state the opt_state rows are per-device
+    SHARDS, not copies (see _init)."""
     model_params, opt_state, batch_stats = _init(rng, sample_images)
     stack = lambda t: jax.tree.map(
         lambda x: jnp.broadcast_to(x[None], (num_replicas,) + x.shape), t)
@@ -245,7 +286,7 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=stack(model_params),
-        opt_state=stack(opt_state),
+        opt_state=opt_state if sharded_state else stack(opt_state),
         batch_stats=stack(batch_stats),
         loss_scale=jnp.asarray(init_loss_scale, jnp.float32),
         loss_scale_normal_steps=jnp.zeros((), jnp.int32),
@@ -264,7 +305,11 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
     # variable_mgr_util.py:313-393).
     forward_params = (buffers["staged_params"] if staged_vars
                       else model_params)
-    replica_id = lax.axis_index(REPLICA_AXIS)
+    # Data-replica id: on the 2-D mesh, model-axis peers fold the SAME
+    # id (same batch shard, same dropout stream), which is what makes
+    # their local gradients identical by construction -- the free
+    # model-axis sub-slice in ops/sharded.py depends on it.
+    replica_id = lax.axis_index(axis_data)
     step_rng = jax.random.fold_in(
         jax.random.fold_in(state.rng, state.step), replica_id)
 
@@ -285,7 +330,7 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
         # power-of-two scale afterwards (exponent shift; bit-identical
         # to dividing first, as the post-hoc path does).
         p = overlap_lib.wrap_tree(
-            p, REPLICA_AXIS, overlap_spec.bucket_bytes,
+            p, axis_data, overlap_spec.bucket_bytes,
             compact_dtype=overlap_spec.compact_dtype,
             exclude_prefixes=module_reduced_prefixes)
       variables = {"params": p}
@@ -395,27 +440,42 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
       # KungFu's runtime does (SURVEY 2.9 "monitored gradient noise
       # scale").
       noise_stats = elastic_lib.noise_scale_stats(
-          grads, REPLICA_AXIS, images.shape[0])
-    if not overlap_in_step:
-      grads = strategy.reduce_gradients(grads, REPLICA_AXIS)
+          grads, axis_data, images.shape[0])
+    grad_shards = None
+    if sharded_state:
+      # ZeRO gradient pass (ops/sharded.py): reduce-scatter of the
+      # batch-axis mean -- each scatter group meets the same B distinct
+      # contributions in the same group order as the replicated pmean,
+      # so the scattered mean is BIT-IDENTICAL to it -- then the free
+      # model-axis sub-slice. The full gradient tree dies here; only
+      # this device's 1/n flat shard flows on.
+      grad_shards = sharded_lib.scatter_mean(grads)
+    elif not overlap_in_step:
+      grads = strategy.reduce_gradients(grads, axis_data)
     # else: the in-backward hooks already reduced every bucket
     # (module-internal hooks for module_reduced_prefixes, the loss_fn
     # wrap for the rest); everything downstream -- the auto-loss-scale
     # finite check, relaxed-consistency banking, the optimizer apply --
     # sees the reduced tree exactly as on the post-hoc path.
 
-    def _all_finite(tree):
+    def _all_finite(tree, axis):
       ok = jnp.all(jnp.stack(
           [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(tree)]))
       # Globally uniform decision (pmin across replicas) so every carried
       # scalar stays replicated (ref chief-only NaN check + broadcast,
       # variable_mgr.py:186-193).
-      return lax.pmin(ok.astype(jnp.int32), REPLICA_AXIS).astype(bool)
+      return lax.pmin(ok.astype(jnp.int32), axis).astype(bool)
 
     # The loss-scale state machine keys on THIS step's fresh gradients
     # (they reflect the current scale), even when the applied gradients
-    # are the deferred ones (ref: variable_mgr_util.py:51-139).
-    fresh_finite = _all_finite(grads) if auto_loss_scale else None
+    # are the deferred ones (ref: variable_mgr_util.py:51-139). On the
+    # sharded path the shards tile the full reduced tree, so the pmin
+    # over BOTH axes covers every element exactly once.
+    if auto_loss_scale:
+      fresh_finite = (_all_finite(grad_shards, axis_all) if sharded_state
+                      else _all_finite(grads, axis_data))
+    else:
+      fresh_finite = None
     new_buffers = dict(buffers)
     if relaxed:
       # --variable_consistency=relaxed: apply the PREVIOUS step's reduced
@@ -433,15 +493,28 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
       grads = buffers["deferred_grads"]
 
     model_params_pre = strategy.pre_update(model_params, state.step,
-                                           REPLICA_AXIS)
-    if getattr(strategy, "sequential_apply", False):
+                                           axis_data)
+    if sharded_state:
+      # The ZeRO apply (the reference's central variable placement
+      # rendered SPMD, variable_mgr.py:201-243): slice this device's
+      # flat param shard (free -- params are replica-identical), run
+      # the optimizer on the 1/n shard ONLY (elementwise optimizers;
+      # validation.py rejects LARS), and all-gather the updated params
+      # for the next forward. Optimizer HBM per device is |state|/n.
+      param_shards = sharded_lib.local_shards(model_params_pre)
+      with jax.named_scope("optimizer_apply"):
+        updates, new_opt_state = tx.update(grad_shards, opt_state,
+                                           param_shards)
+        new_shards = optax.apply_updates(param_shards, updates)
+      new_params = sharded_lib.gather_tree(new_shards, model_params_pre)
+    elif getattr(strategy, "sequential_apply", False):
       # Async PS with a stateful optimizer (strategies.py): serialize
       # every replica's unaveraged gradient through the SHARED optimizer
       # state, in replica-index order -- the deterministic SPMD
       # rendering of the PS's one-at-a-time applications (ref async
       # mode: benchmark_cnn.py:520-522).
       g_all = jax.tree.map(
-          lambda g: lax.all_gather(g, REPLICA_AXIS, axis=0), grads)
+          lambda g: lax.all_gather(g, axis_data, axis=0), grads)
 
       def _apply_one(carry, g):
         prms, ost = carry
@@ -465,8 +538,8 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
         updates, new_opt_state = tx.update(grads, opt_state,
                                            model_params_pre)
         new_params = optax.apply_updates(model_params_pre, updates)
-    new_params = strategy.post_update(new_params, state.step, REPLICA_AXIS)
-    new_bs = strategy.sync_batch_stats(new_bs, REPLICA_AXIS)
+    new_params = strategy.post_update(new_params, state.step, axis_data)
+    new_bs = strategy.sync_batch_stats(new_bs, axis_data)
 
     if auto_loss_scale:
       # Auto loss-scale state machine (ref: variable_mgr_util.py:51-139):
@@ -525,8 +598,8 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
               jnp.stack([base_loss.astype(jnp.float32),
                          total_loss.astype(jnp.float32)]),
               telemetry_lib.health_partials(
-                  grads, model_params, updates, REPLICA_AXIS)]),
-          REPLICA_AXIS)
+                  grads, model_params, updates, axis_data)]),
+          axis_data)
       metrics = {
           "base_loss": packed[0],
           "total_loss": packed[1],
@@ -535,9 +608,13 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
               packed[2:], new_scale, skipped, suppressed),
       }
     else:
+      # Metric pmeans reduce over the DATA axis only: model-axis peers
+      # compute the identical loss from the identical batch shard, so
+      # the batch-group mean is already the global value -- and it is
+      # bit-identical to the replicated path's B-contribution pmean.
       metrics = {
-          "base_loss": lax.pmean(base_loss, REPLICA_AXIS),
-          "total_loss": lax.pmean(total_loss, REPLICA_AXIS),
+          "base_loss": lax.pmean(base_loss, axis_data),
+          "total_loss": lax.pmean(total_loss, axis_data),
           "learning_rate": lr,
       }
     if steps_per_dispatch > 1:
@@ -554,10 +631,18 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
         # full-tree replicated square-sum pass -- the replicated pass is
         # the ~2x-step-time cost _sharded_sumsq exists to avoid.
         metrics["grad_norm"] = metrics["health"][0]
+      elif sharded_state:
+        # The flat shards tile the reduced gradient exactly once, so
+        # the psum of per-shard square-sums over BOTH axes is the global
+        # square-sum -- no full-tree pass, same cost argument as the
+        # health path's sharded reduction.
+        metrics["grad_norm"] = jnp.sqrt(lax.psum(
+            sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree.leaves(grad_shards)), axis_all))
       else:
         metrics["grad_norm"] = lax.pmean(
             jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                         for g in jax.tree.leaves(grads))), REPLICA_AXIS)
+                         for g in jax.tree.leaves(grads))), axis_data)
     if params.print_training_accuracy:
       # Under microbatching the per-microbatch scalar accuracies were
       # averaged inside the scan (equal microbatch sizes make that the
@@ -567,7 +652,7 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
       # Scalars only: detection accuracy_functions also return per-box
       # arrays (decoded predictions), which are not replicated step
       # metrics.
-      metrics.update({k: lax.pmean(v, REPLICA_AXIS)
+      metrics.update({k: lax.pmean(v, axis_data)
                       for k, v in acc.items() if jnp.ndim(v) == 0})
     if noise_stats is not None:
       metrics["noise_scale_g2"], metrics["noise_scale_s"] = noise_stats
@@ -603,7 +688,7 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
   check_vma = not getattr(model, "relax_shard_map_vma", False)
   train_sharded = jax.shard_map(
       per_replica_train, mesh=mesh,
-      in_specs=(state_specs, P(REPLICA_AXIS), P(REPLICA_AXIS)),
+      in_specs=(state_specs, P(axis_data), P(axis_data)),
       out_specs=(state_specs, P()), check_vma=check_vma)
 
   train_step = jax.jit(train_sharded, donate_argnums=(0,))
@@ -632,8 +717,8 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
   if steps_per_dispatch > 1:
     chunk_sharded = jax.shard_map(
         per_replica_train_chunk, mesh=mesh,
-        in_specs=(state_specs, P(None, REPLICA_AXIS),
-                  P(None, REPLICA_AXIS)),
+        in_specs=(state_specs, P(None, axis_data),
+                  P(None, axis_data)),
         out_specs=(state_specs, P()), check_vma=check_vma)
     train_chunk = jax.jit(chunk_sharded, donate_argnums=(0,))
 
@@ -650,28 +735,28 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
     result = BuildNetworkResult(logits=(logits, aux_logits))
     acc = model.accuracy_function(result, labels)
     loss = model.loss_function(result, labels)
-    metrics = {k: lax.pmean(v, REPLICA_AXIS)
+    metrics = {k: lax.pmean(v, axis_data)
                for k, v in acc.items() if jnp.ndim(v) == 0}
     # Loss included so the forward-only timed loop can print the standard
     # step line (ref forward-only mode: benchmark_cnn.py:124-126).
-    metrics["base_loss"] = lax.pmean(loss, REPLICA_AXIS)
+    metrics["base_loss"] = lax.pmean(loss, axis_data)
     metrics["total_loss"] = metrics["base_loss"]
     return metrics
 
   eval_sharded = jax.shard_map(
       per_replica_eval, mesh=mesh,
-      in_specs=(state_specs, P(REPLICA_AXIS), P(REPLICA_AXIS)),
+      in_specs=(state_specs, P(axis_data), P(axis_data)),
       out_specs=P(), check_vma=check_vma)
   eval_step = jax.jit(eval_sharded)
 
   # -- broadcast-init (strategy-dependent; ref: benchmark_cnn.py:2094-2100) --
 
   def per_replica_broadcast(tree):
-    return _expand(strategy.broadcast_init(_squeeze(tree), REPLICA_AXIS))
+    return _expand(strategy.broadcast_init(_squeeze(tree), axis_data))
 
   broadcast_sharded = jax.shard_map(
       per_replica_broadcast, mesh=mesh,
-      in_specs=(P(REPLICA_AXIS),), out_specs=P(REPLICA_AXIS))
+      in_specs=(P(axis_all),), out_specs=P(axis_all))
   broadcast_init = jax.jit(broadcast_sharded)
 
   return init_state_fn, train_step, eval_step, broadcast_init, train_chunk

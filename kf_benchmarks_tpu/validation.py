@@ -19,6 +19,21 @@ class ParamError(ValueError):
   pass
 
 
+def parse_mesh_shape(mesh_shape: str):
+  """'BxM' -> (B, M), both positive ints (ParamError otherwise). Pure
+  (no jax): callable from validation and from the mesh builder."""
+  parts = str(mesh_shape).lower().split("x")
+  try:
+    dims = [int(v) for v in parts]
+  except ValueError:
+    dims = []
+  if len(dims) != 2 or any(d < 1 for d in dims):
+    raise ParamError(
+        f"--mesh_shape={mesh_shape!r}: expected 'BxM' with positive "
+        "integer batch and model axis sizes (e.g. 8x1, 4x2)")
+  return dims[0], dims[1]
+
+
 # Flags with NO cross-flag constraint, each with the reason -- the
 # explicit no-validation marker the hazard lint requires (analysis/
 # lint.py rule 'flag-validation'): every flag in the params registry
@@ -98,10 +113,8 @@ NO_CROSS_FLAG_VALIDATION = {
     # Cluster wiring: free-form host lists/ids consumed by cluster.py;
     # the modes that REQUIRE them are validated via job_name above.
     "ps_hosts": "cluster wiring string (cluster.py)",
-    "worker_hosts": "cluster wiring string (cluster.py)",
     "task_index": "cluster wiring index (cluster.py)",
     "process_index": "cluster wiring index (cluster.py)",
-    "num_processes": "cluster wiring count (kfrun.py)",
     "horovod_device": "accepted for reference CLI parity; TPU runs have "
                       "no per-process device pick",
     "server_protocol": "accepted for reference CLI parity; no grpc "
@@ -227,6 +240,113 @@ def validate_cross_flags(params) -> None:
           "--num_grad_accum > 1 cannot be combined with "
           "--adaptive_batch_size: the policy re-picks the per-device "
           "batch mid-run and cannot guarantee divisibility by M")
+  mesh_shape = getattr(p, "mesh_shape", None)
+  sharded = bool(getattr(p, "shard_optimizer_state", False))
+  if mesh_shape:
+    b, m = parse_mesh_shape(mesh_shape)
+    if b * m != p.num_devices:
+      raise ParamError(
+          f"--mesh_shape={mesh_shape} spans {b * m} devices but "
+          f"--num_devices={p.num_devices}: the named 2-D mesh must "
+          "cover exactly the requested devices")
+    if m > 1 and not sharded:
+      raise ParamError(
+          f"--mesh_shape={mesh_shape}: a model axis > 1 requires "
+          "--shard_optimizer_state -- without it the core step has no "
+          "consumer for the axis and would silently duplicate every "
+          "forward/backward M times")
+  if sharded:
+    # --shard_optimizer_state exclusion matrix. The sharded step
+    # replaces the strategy's gradient pass with reduce-scatter +
+    # all-gather and applies the optimizer on 1/n flat state shards
+    # (ops/sharded.py); modes below either own gradient aggregation
+    # themselves, need per-replica gradient trees the scatter never
+    # materializes, or read full-tree state the shards no longer hold.
+    if p.eval or p.forward_only:
+      raise ParamError(
+          "--shard_optimizer_state applies to training only (there is "
+          "no optimizer state to shard in --eval/--forward_only)")
+    if p.variable_update not in ("replicated", "parameter_server"):
+      raise ParamError(
+          "--shard_optimizer_state requires --variable_update="
+          f"replicated or parameter_server (got {p.variable_update!r}): "
+          "independent/gossip modes keep per-replica diverged state "
+          "with no global reduction to scatter, and the distributed_* "
+          "modes' multi-process worlds are not wired to the sharded "
+          "checkpoint layout yet")
+    if not p.cross_replica_sync:
+      raise ParamError(
+          "--shard_optimizer_state cannot be combined with async "
+          "parameter_server (--cross_replica_sync=false): the "
+          "sequential-apply path serializes each replica's UNAVERAGED "
+          "gradient through one shared full state copy "
+          "(train_step.py); sharded state has no such copy")
+    if p.job_name or (p.worker_hosts or []) or (p.num_processes or 1) > 1:
+      raise ParamError(
+          "--shard_optimizer_state is single-process for now: the "
+          "checkpoint path saves the sharded optimizer state from "
+          "locally-addressable rows (checkpoint.py), which a "
+          "multi-host mesh cannot do chief-only without a cross-host "
+          "gather leg")
+    if p.optimizer == "lars":
+      raise ParamError(
+          "--shard_optimizer_state cannot be combined with "
+          "--optimizer=lars: the LARS trust ratio needs per-LAYER "
+          "param/update norms, and the flat 1/n shard cuts across "
+          "layer boundaries. Every other stock optimizer updates "
+          "elementwise, so the shard apply stays exact")
+    if p.staged_vars:
+      raise ParamError(
+          "--shard_optimizer_state cannot be combined with "
+          "--staged_vars: staged reads keep a second full weight copy "
+          "per device (variable_mgr.py:246-274), the exact footprint "
+          "sharded state exists to retire")
+    if p.variable_consistency == "relaxed":
+      raise ParamError(
+          "--shard_optimizer_state cannot be combined with "
+          "--variable_consistency=relaxed: the deferred-gradient bank "
+          "stores a full gradient tree per device "
+          "(train_step.py buffers); banking shards instead would "
+          "change the staleness semantics silently")
+    if p.adaptive_batch_size or p.track_grad_noise_scale:
+      raise ParamError(
+          "--shard_optimizer_state cannot be combined with "
+          "--adaptive_batch_size/--track_grad_noise_scale: the "
+          "noise-scale estimator contrasts PRE-reduction per-replica "
+          "gradients with their replica mean (elastic.py), and the "
+          "scattered reduction never materializes the replica mean")
+    if getattr(p, "overlap_gradient_reduction", False):
+      raise ParamError(
+          "--shard_optimizer_state cannot be combined with "
+          "--overlap_gradient_reduction: the in-backward hooks issue "
+          "bucket pmeans (all-reduce), which is exactly the collective "
+          "the sharded path replaces with reduce-scatter")
+    for flag, name in ((p.all_reduce_spec, "--all_reduce_spec"),
+                       (p.gradient_repacking, "--gradient_repacking"),
+                       (p.agg_small_grads_max_bytes > 0,
+                        "--agg_small_grads_max_bytes"),
+                       (p.hierarchical_copy, "--hierarchical_copy")):
+      if flag:
+        raise ParamError(
+            f"--shard_optimizer_state cannot be combined with {name}: "
+            "each reducer owns the reduction granularity (ref: "
+            "batch_allreduce.py:300-317 selects one algorithm); the "
+            "sharded path's reduction IS the per-leaf reduce-scatter")
+    if p.elastic:
+      raise ParamError(
+          "--shard_optimizer_state cannot be combined with --elastic: "
+          "a resize changes the shard count, and the in-mesh reshape "
+          "path restores state across topologies by replica-0 "
+          "broadcast (benchmark.py _reshape_topology) -- resharding "
+          "1/n flat shards onto a different n is ROADMAP item 3's "
+          "checkpointed-rescale leg, not wired yet")
+    if p.health_stats:
+      raise ParamError(
+          "--health_stats cannot be combined with "
+          "--shard_optimizer_state: the in-step stats read the full "
+          "per-step update tree (telemetry.py health_partials), and "
+          "the sharded apply only materializes this device's 1/n "
+          "update shard. Drop the flag (auto-off with a note)")
   if (p.adaptive_batch_size and
       p.adaptive_batch_min > p.adaptive_batch_max):
     raise ParamError(

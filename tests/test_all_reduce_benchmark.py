@@ -91,3 +91,64 @@ def test_run_sweep_emits_table_and_json_line(capsys):
   record = json.loads(out_lines[0])
   assert record["metric"] == "all_reduce_sweep"
   assert len(record["rows"]) == len(rows)
+
+
+def test_run_sweep_primitive_collective_rows(capsys):
+  """The reduce-scatter / all-gather rows beside all-reduce: the
+  sharded optimizer path's collective mix (--shard_optimizer_state,
+  ops/sharded.py) timed in the same n x spec x size format, and in the
+  DEFAULT --sweep_specs so the table carries them unasked."""
+  import json
+  from kf_benchmarks_tpu import flags
+  assert "reduce_scatter" in flags.param_specs["sweep_specs"].default_value
+  assert "all_gather" in flags.param_specs["sweep_specs"].default_value
+  from kf_benchmarks_tpu.utils import log as log_util
+  params = params_lib.make_params(
+      device="cpu", num_devices=4, num_batches=2, num_warmup_batches=1,
+      iters_per_step=2, sweep=True,
+      sweep_specs="psum,reduce_scatter,all_gather", sweep_sizes="4k")
+  logs = []
+  orig = log_util.log_fn
+  log_util.log_fn = logs.append
+  try:
+    rows = arb.run_sweep(params)
+  finally:
+    log_util.log_fn = orig
+  # n in {2, 4} x 3 specs x 1 size, one markdown row each.
+  assert len(rows) == 2 * 3
+  assert {r["spec"] for r in rows} == {"psum", "reduce_scatter",
+                                       "all_gather"}
+  assert all(r["step_ms"] > 0 and r["all_reduce_ms"] >= 0 for r in rows)
+  for name in ("reduce_scatter", "all_gather"):
+    assert sum(1 for l in logs
+               if l.startswith("| ") and f" {name} " in l) == 2
+  record = json.loads([l for l in capsys.readouterr().out.splitlines()
+                       if l.strip().startswith("{")][0])
+  assert len(record["rows"]) == len(rows)
+
+
+def test_build_primitive_step_rejects_unknown():
+  mesh = mesh_lib.build_mesh(2, "cpu")
+  with pytest.raises(ValueError, match="primitive"):
+    arb.build_primitive_step(mesh, "psum", 1)
+
+
+def test_primitive_rows_pad_non_divisible_cells():
+  """sweep_device_counts emits non-power-of-two totals (e.g. 6), where
+  a 1k cell (256 f32 elems) does not divide the mesh: the scatter row
+  must zero-pad like its real consumers instead of crashing the
+  default sweep."""
+  from kf_benchmarks_tpu.utils import log as log_util
+  params = params_lib.make_params(
+      device="cpu", num_devices=6, num_batches=1, num_warmup_batches=1,
+      iters_per_step=1, sweep=True,
+      sweep_specs="reduce_scatter,all_gather", sweep_sizes="1k")
+  orig = log_util.log_fn
+  log_util.log_fn = lambda s: None
+  try:
+    rows = arb.run_sweep(params)
+  finally:
+    log_util.log_fn = orig
+  # n in {2, 4, 6} x 2 primitives; the n=6 cells are the regression.
+  assert len(rows) == 3 * 2
+  assert all(r["step_ms"] > 0 for r in rows)

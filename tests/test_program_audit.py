@@ -208,7 +208,71 @@ MUTATIONS = [
      lambda c: c.collectives.__setitem__(
          slice(None), [x for x in c.collectives if not x.in_loop]),
      "overlap-in-backward"),
+    # PR 6 seeds. Replacing the scatter with a full all-reduce is the
+    # exact regression --shard_optimizer_state exists to rule out: the
+    # replicated exchange returns, and with it the 2(n-1)/n wire.
+    ("full_all_reduce_instead_of_reduce_scatter", "sharded_base",
+     lambda c: (c.collectives.__setitem__(
+         slice(None),
+         [x for x in c.collectives if x.kind != "reduce-scatter"]),
+                _add_collective(c)),
+     "sharded-collectives"),
+    ("partial_reduce_scatter_groups", "sharded_base",
+     lambda c: _add_collective(c, kind="reduce-scatter",
+                               replica_groups="{{0,1,2,3},{4,5,6,7}}"),
+     "sharded-collectives"),
+    # Opt state silently re-replicated: per-device bytes jump from
+    # ~|state|/n back to |state| (n x the shard) -- the ZeRO memory
+    # claim is the thing being audited, not the collective mix.
+    ("replicated_opt_state_leak", "sharded_base",
+     lambda c: c.aux.update(
+         opt_state_bytes_per_device=(
+             c.aux["opt_state_bytes_per_device"] * c.aux["num_devices"])),
+     "sharded-opt-bytes"),
 ]
+
+
+def test_audit_clean_on_4x2_model_axis_config(tracer):
+  """A real model axis (M=2) must audit clean end-to-end: the metric
+  pmeans legitimately span 4-wide batch groups (model peers hold
+  identical scalars), which rule_full_mesh_replica_groups admits for
+  sharded configs, and the opt-bytes twin drops --mesh_shape."""
+  contract = tracer(dict(model="trivial", batch_size=4,
+                         optimizer="momentum",
+                         shard_optimizer_state=True, mesh_shape="4x2"),
+                    "train_step")
+  violations = audit.audit_contract(contract, tracer)
+  assert not violations, [v.as_dict() for v in violations]
+  sizes = {tuple(audit._group_sizes(c.replica_groups))
+           for c in contract.collectives
+           if c.kind == "all-reduce" and c.replica_groups}
+  assert (4, 4) in sizes  # the batch-axis scalar pmeans, 2 groups of 4
+
+
+def test_sharded_opt_bytes_twin_drops_mesh_shape():
+  """The replicated twin of rule_sharded_opt_bytes must drop
+  --mesh_shape along with --shard_optimizer_state: a model axis > 1 is
+  only valid WITH sharded state (validation.py), so a twin keeping it
+  would crash the audit of any documented 4x2 config."""
+  contract = contracts.extract_contract(
+      _FAKE_HLO, config=dict(model="trivial", optimizer="momentum",
+                             shard_optimizer_state=True,
+                             mesh_shape="4x2"))
+  contract.aux.update(opt_state_bytes_per_device=100_000, num_devices=8)
+  seen = []
+
+  def stub_tracer(cfg, program):
+    seen.append(dict(cfg))
+    twin = contracts.extract_contract(_FAKE_HLO, config=dict(cfg))
+    twin.aux["opt_state_bytes_per_device"] = 800_000
+    return twin
+
+  assert not audit.rule_sharded_opt_bytes(contract, stub_tracer)
+  assert seen and "mesh_shape" not in seen[0]
+  assert "shard_optimizer_state" not in seen[0]
+  # And the bound itself still bites on the same twin.
+  contract.aux["opt_state_bytes_per_device"] = 800_000
+  assert audit.rule_sharded_opt_bytes(contract, stub_tracer)
 
 
 @pytest.mark.parametrize("seed,config,mutate,expected",

@@ -315,3 +315,40 @@ def test_alternate_mesh_shapes():
     _, loss = step(jax.tree.map(jnp.copy, params), tokens, labels)
     np.testing.assert_allclose(float(loss), want, rtol=1e-5,
                                atol=1e-6, err_msg=str(shape))
+
+
+@pytest.mark.parametrize("shape", [(2, 2, 2), (1, 4, 2), (4, 2, 1)])
+def test_compose_on_model_axis_matches_legacy_mesh(shape):
+  """The shared-axis-system mesh (('batch', 'seq', 'tensor'), the
+  'model' axis of parallel/mesh.py's 2-D family refined into its
+  seq x tensor factors) runs BIT-identically to the legacy
+  ('replica', 'seq', 'tensor') grid: axis names route collectives, not
+  numerics. Holds on every jax (both arms share the same semantics),
+  unlike the oracle comparisons above."""
+  params, tokens, labels = _setup(seed=11)
+  mesh_a = transformer.build_mesh(*shape)
+  mesh_b = transformer.compose_on_model_axis(*shape)
+  assert mesh_b.axis_names == ("batch", "seq", "tensor")
+  step_a = transformer.make_train_step(mesh_a, params, learning_rate=0.1)
+  step_b = transformer.make_train_step(mesh_b, params, learning_rate=0.1)
+  pa, la = step_a(jax.tree.map(jnp.copy, params), tokens, labels)
+  pb, lb = step_b(jax.tree.map(jnp.copy, params), tokens, labels)
+  assert float(la) == float(lb)
+  for a, b in zip(jax.tree.leaves(pa), jax.tree.leaves(pb)):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_compose_on_model_axis_moe_expert_axis():
+  # MoE expert stacks shard over the DATA axis on either naming: the
+  # composed trainer's ep leg follows the tokens.
+  cfg = dict(CFG, moe_every=2, n_experts=2)
+  params = transformer.init_params(jax.random.PRNGKey(5), **cfg)
+  tokens = jax.random.randint(jax.random.PRNGKey(6), (4, 16), 0,
+                              cfg["vocab"])
+  labels = jnp.roll(tokens, -1, axis=1)
+  mesh = transformer.compose_on_model_axis(2, 2, 2)
+  specs = transformer.param_specs(params, data_axis="batch")
+  assert specs["blocks"][1]["ew1"] == transformer.P("batch")
+  step = transformer.make_train_step(mesh, params, learning_rate=0.1)
+  _, loss = step(jax.tree.map(jnp.copy, params), tokens, labels)
+  assert np.isfinite(float(loss))
