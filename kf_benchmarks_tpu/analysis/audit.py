@@ -335,6 +335,58 @@ def rule_full_mesh_replica_groups(contract, tracer):
   return []
 
 
+# -- resume-time contract re-verification -------------------------------------
+
+def check_resumed_state(state, mesh, sharded_state: bool) -> List[str]:
+  """Host-side structural re-verification of a TrainState that was just
+  rebuilt onto a (possibly different) mesh -- after an elastic rescale
+  or a cross-topology checkpoint restore (benchmark.py calls this at
+  both seams; the traced-program half of the same contract lives in the
+  ``sharded_rescale`` golden).
+
+  Cheap (shape/dtype reads only, no device work) and deliberately
+  strict: a rescale that silently produced a wrong-topology state would
+  train -- broadcast semantics make almost any leading dim "work" --
+  and corrupt the run long after the seam. Returns problem strings
+  (empty = contract holds)."""
+  problems = []
+  n = int(mesh.devices.size)
+
+  def leading(tree, what):
+    for leaf in _tree_leaves(tree):
+      shape = tuple(getattr(leaf, "shape", ()))
+      if not shape or shape[0] != n:
+        problems.append(
+            f"{what} leaf shape {shape} does not carry the {n}-row "
+            "stacked leading dim of the rebuilt mesh")
+        return
+
+  leading(state.params, "params")
+  leading(state.batch_stats, "batch_stats")
+  if sharded_state:
+    for leaf in _tree_leaves(state.opt_state):
+      shape = tuple(getattr(leaf, "shape", ()))
+      if not shape or shape[0] != n:
+        problems.append(
+            f"sharded opt_state leaf shape {shape} is not an (n, k) "
+            f"shard stack for the {n}-device mesh -- the rescale left "
+            "state at the old shard count")
+        break
+  else:
+    leading(state.opt_state, "opt_state")
+  if tuple(getattr(state.step, "shape", ())) != ():
+    problems.append("step is not a replicated scalar after resume")
+  return problems
+
+
+def _tree_leaves(tree):
+  try:
+    import jax
+    return jax.tree.leaves(tree)
+  except Exception:
+    return []
+
+
 RULES: Dict[str, Callable] = {
     "accum-one-collective": rule_accum_one_collective,
     "overlap-in-backward": rule_overlap_in_backward,

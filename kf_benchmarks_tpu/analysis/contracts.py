@@ -373,4 +373,13 @@ GOLDEN_CONFIGS: "OrderedDict[str, Dict[str, Any]]" = OrderedDict([
     ("lm_sharded", dict(model="transformer_lm", batch_size=8,
                         optimizer="momentum",
                         shard_optimizer_state=True)),
+    # PR 7: the elastic-rescale RESUME shape -- sharded_base after an
+    # 8 -> 4 resize (the program benchmark.py rebuilds at the new mesh
+    # and resumes into from the resliced checkpoint). Every sharded
+    # rule re-checks at n=4: 4-wide scatter groups, full-4-device
+    # gathers, no full-gradient all-reduce -- so a resumed run's
+    # program shape is golden-pinned, not just the original's.
+    ("sharded_rescale", dict(model="trivial", batch_size=4,
+                             num_devices=4, optimizer="momentum",
+                             shard_optimizer_state=True)),
 ])

@@ -30,6 +30,12 @@ Rules (ids):
   appears in ``validation.py`` or carries an explicit entry in its
   ``NO_CROSS_FLAG_VALIDATION`` marker (with a reason); a flag that is
   both is a stale marker.
+* ``signal-chain`` -- a ``signal.signal`` registration outside
+  ``telemetry.py``/``faults.py`` must capture the previous handler so
+  it can chain (the PR-4 SIGTERM contract: a handler that discards the
+  chain silences the flight-recorder post-mortem, or eats ctrl-C). A
+  bare ``signal.signal(...)`` statement drops the old handler on the
+  floor; the compliant form assigns it.
 * ``citation`` -- every top-level module (and subpackage) cites the
   reference ``file:line`` span it covers, with a reasoned allowlist
   for TPU-native-only modules (folded in from the former standalone
@@ -96,6 +102,8 @@ VERSION_GATE_ALLOWLIST = {
 
 KILL_TIMEOUT_ALLOWLIST: Dict[str, str] = {}
 
+SIGNAL_CHAIN_ALLOWLIST: Dict[str, str] = {}
+
 # Citation allowlist (moved here from tests/test_citation_lint.py):
 # TPU-native-only units with NO reference analog; each entry names why.
 # Directory entries (trailing '/') cover a whole subpackage.
@@ -105,6 +113,9 @@ CITATION_ALLOWLIST = {
     "elastic.py": "elastic scaling lives in KungFu's external runtime, "
                   "not the reference repo (SURVEY 2.9); TPU-native "
                   "design module",
+    "faults.py": "deterministic fault injection for the elastic tests; "
+                 "the reference never kills a worker (KungFu's failure "
+                 "handling is external runtime, SURVEY 2.9)",
     "telemetry.py": "runtime training-health layer; the reference's "
                     "observability is post-hoc only (SURVEY 5.1/9)",
     "analysis/": "static program-contract auditor + this lint; the "
@@ -330,6 +341,73 @@ def rule_kill_timeout(sources: List[_Source]) -> List[LintViolation]:
   return out
 
 
+# -- rule: signal-chain ------------------------------------------------------
+
+# The two modules allowed to own handler registration: telemetry.py
+# (the chained SIGTERM/SIGINT post-mortem handlers, PR 4) and faults.py
+# (the injection harness that exercises them).
+_SIGNAL_HOMES = ("kf_benchmarks_tpu/telemetry.py",
+                 "kf_benchmarks_tpu/faults.py")
+
+
+def _imported_signal_names(tree: ast.AST):
+  """(direct, modules): local names bound to signal.signal by ``from
+  signal import signal [as X]`` (the direct-call form) and local names
+  the signal MODULE is bound to by ``import signal [as Y]`` (the
+  ``Y.signal(...)`` form)."""
+  direct, modules = set(), set()
+  for node in ast.walk(tree):
+    if isinstance(node, ast.ImportFrom) and node.module == "signal":
+      for alias in node.names:
+        if alias.name == "signal":
+          direct.add(alias.asname or alias.name)
+    elif isinstance(node, ast.Import):
+      for alias in node.names:
+        if alias.name == "signal":
+          modules.add(alias.asname or alias.name)
+  return direct, modules
+
+
+def _is_signal_signal_call(node: ast.Call, direct_names: set,
+                           module_names: set) -> bool:
+  if isinstance(node.func, ast.Attribute) and node.func.attr == "signal":
+    base = ast.unparse(node.func.value).split(".")[-1]
+    return base == "signal" or base in module_names
+  if isinstance(node.func, ast.Name):
+    return node.func.id in direct_names
+  return False
+
+
+def rule_signal_chain(sources: List[_Source]) -> List[LintViolation]:
+  out, hits = [], set()
+  for src in sources:
+    if src.path in _SIGNAL_HOMES or src.tree is None:
+      continue
+    direct_names, module_names = _imported_signal_names(src.tree)
+    for node in ast.walk(src.tree):
+      # A registration whose RESULT is discarded (a bare expression
+      # statement) drops the previous handler; the compliant form
+      # assigns it so the new handler can chain.
+      if not (isinstance(node, ast.Expr)
+              and isinstance(node.value, ast.Call)
+              and _is_signal_signal_call(node.value, direct_names,
+                                         module_names)):
+        continue
+      hits.add(src.path)
+      if src.path in SIGNAL_CHAIN_ALLOWLIST:
+        continue
+      out.append(LintViolation(
+          "signal-chain", src.path, node.lineno,
+          "signal.signal registration discards the previous handler -- "
+          "capture it (`old = signal.signal(...)`) and chain, or move "
+          "the registration into telemetry.py/faults.py (the PR-4 "
+          "SIGTERM chaining contract: an unchained handler silences "
+          "the flight-recorder post-mortem or eats ctrl-C)"))
+  out += _stale_allowlist("signal-chain", SIGNAL_CHAIN_ALLOWLIST, hits,
+                          {s.path for s in sources})
+  return out
+
+
 # -- rule: step-line-format --------------------------------------------------
 
 # Concatenated so this module's own constants never contain the marker
@@ -489,6 +567,7 @@ RULES = {
     "block-until-ready": rule_block_until_ready,
     "version-gate-comment": rule_version_gate_comment,
     "kill-timeout": rule_kill_timeout,
+    "signal-chain": rule_signal_chain,
     "step-line-format": rule_step_line_format,
     "flag-validation": rule_flag_validation,
     "citation": rule_citation,

@@ -21,6 +21,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from kf_benchmarks_tpu import checkpoint
 from kf_benchmarks_tpu import cluster as cluster_lib
 from kf_benchmarks_tpu import elastic as elastic_lib
+from kf_benchmarks_tpu import faults as faults_lib
 from kf_benchmarks_tpu import learning_rate
 from kf_benchmarks_tpu import observability
 from kf_benchmarks_tpu import optimizers
@@ -355,6 +356,11 @@ class BenchmarkCNN:
       params = params._replace(health_stats=hs)
       self.params = params
     self._telemetry = None
+    # Deterministic fault injection (--fault_schedule, faults.py): the
+    # named faults fire at dispatch boundaries; the dispatch planner
+    # treats their steps as events so a chunk never crosses one.
+    self._faults = faults_lib.FaultInjector.from_params(
+        params, rank=cluster_lib.process_rank(), log_fn=log_fn)
     self.num_batches = self._get_num_batches()
     # Device-resident multi-step dispatch (--steps_per_dispatch=K): K
     # train steps per compiled program (train_step.py train_chunk), so
@@ -673,14 +679,26 @@ class BenchmarkCNN:
         self._telemetry = None
       self._input_stop()
 
-  def _open_input(self, rng, subset: str):
+  def _open_input(self, rng, subset: str, bump: bool = True):
     """Open a fresh input stream, closing the previous one (elastic
-    reshapes swap streams mid-run)."""
+    reshapes swap streams mid-run). ``bump=False`` reopens at the
+    CURRENT incarnation (the checkpoint-resume path, which sets the
+    incarnation from the snapshot rather than advancing it)."""
     stop_prev = getattr(self, "_input_stop", None)
     if stop_prev is not None:
       stop_prev()
-      self._input_incarnation = getattr(self, "_input_incarnation", 0) + 1
-      rng = jax.random.fold_in(rng, self._input_incarnation)
+      if bump:
+        self._input_incarnation = getattr(self, "_input_incarnation",
+                                          0) + 1
+    incarnation = getattr(self, "_input_incarnation", 0)
+    if incarnation:
+      # Folded only for incarnation >= 1 (a plain run's stream is the
+      # seed rng exactly, keeping every pre-elastic pin); keyed on the
+      # COUNT rather than the fold history so a run resuming after a
+      # reshape can reproduce stream k exactly by presetting
+      # _input_incarnation -- the bit-identity A/B of the elastic
+      # rescale tests depends on it.
+      rng = jax.random.fold_in(rng, incarnation)
     # Training streams stage --steps_per_dispatch batches per fetch
     # (already 1 in eval/forward-only modes, validation.py).
     chunk = self.steps_per_dispatch if subset == "train" else 1
@@ -698,18 +716,32 @@ class BenchmarkCNN:
     """
     # State-dict form, the same shape restore_state consumes when reading
     # a checkpoint file (namedtuple opt states become plain dicts).
+    # Under --shard_optimizer_state the snapshot carries the FULL (n, k)
+    # shard stack, which restore_state re-slices onto the new topology
+    # (checkpoint.py _reshard -- the cross-mesh rescale).
     from flax import serialization
-    snapshot = serialization.to_state_dict(checkpoint.savable_state(state))
+    sharded = self._sharded_state
+    snapshot = serialization.to_state_dict(
+        checkpoint.savable_state(state, sharded_opt_state=sharded))
     self.num_devices = num_devices
-    self.params = self.params._replace(num_devices=num_devices)
+    params_new = self.params._replace(num_devices=num_devices)
     self.batch_size_per_device = batch_per_device
     self.model.set_batch_size(batch_per_device)
-    self.batch_size = batch_per_device * num_devices
-    # Elastic is 1-D replica-mesh only (--shard_optimizer_state is
-    # rejected with --elastic in validation.py: resharding 1/n state
-    # shards across a resize is the checkpointed-rescale leg).
-    self.mesh = mesh_lib.build_mesh(num_devices, self.params.device)
-    self.num_data_replicas = num_devices
+    if mesh_lib.BATCH_AXIS in self.mesh.axis_names:
+      # 2-D family: the model-axis width survives the resize (the poll
+      # path rejected targets it does not divide); the batch axis takes
+      # the rest, so the global batch follows the DATA width only.
+      nm = int(self.mesh.shape[mesh_lib.MODEL_AXIS])
+      self.mesh = mesh_lib.build_mesh_2d(num_devices // nm, nm,
+                                         params_new.device)
+      if params_new.mesh_shape:
+        params_new = params_new._replace(
+            mesh_shape=f"{num_devices // nm}x{nm}")
+    else:
+      self.mesh = mesh_lib.build_mesh(num_devices, params_new.device)
+    self.params = params_new
+    self.num_data_replicas = mesh_lib.num_data_replicas(self.mesh)
+    self.batch_size = batch_per_device * self.num_data_replicas
     # Rebuild the strategy: its reducer may capture topology-derived
     # constants sized to the OLD axis (hierarchical_copy groups,
     # planner replica hints), which would mis-permute on the new mesh.
@@ -725,10 +757,38 @@ class BenchmarkCNN:
     next_batch = self._open_input(self._data_rng, "train")
     shape = (batch_per_device,) + self._model_image_shape()
     new_state = init_state(init_rng, jnp.zeros(shape, jnp.float32))
-    new_state = checkpoint.restore_state(new_state, snapshot)
+    new_state = checkpoint.restore_state(new_state, snapshot,
+                                         sharded_opt_state=sharded)
     new_state = new_state.replace(
         params=broadcast_init(new_state.params))
+    self._verify_resumed_state(new_state)
     return new_state, train_step, eval_step, next_batch, train_chunk
+
+  def _save_checkpoint(self, state, incarnation_bump: int = 0) -> None:
+    """The ONE checkpoint-write path: layout flag + the input-stream
+    incarnation a resumed run must reopen at. ``incarnation_bump=1`` at
+    the resize seam: the snapshot's resume point is the POST-resize
+    stream (the rebuild bumps the incarnation right after this save)."""
+    checkpoint.save_checkpoint(
+        self.params.train_dir, state, self.params.max_ckpts_to_keep,
+        sharded_opt_state=self._sharded_state,
+        input_incarnation=getattr(self, "_input_incarnation", 0)
+        + incarnation_bump)
+
+  def _verify_resumed_state(self, state) -> None:
+    """Resume-time contract re-verification (analysis/audit.py): every
+    state rebuilt onto a new (or restored) mesh must structurally match
+    it BEFORE training continues -- a wrong-topology state would train
+    under broadcast semantics and corrupt the run long after the seam.
+    The traced-program half of the same contract is the
+    ``sharded_rescale`` golden (run_tests.py --audit)."""
+    from kf_benchmarks_tpu.analysis import audit as audit_lib
+    problems = audit_lib.check_resumed_state(state, self.mesh,
+                                             self._sharded_state)
+    if problems:
+      raise RuntimeError(
+          "resume contract violated on the rebuilt mesh: "
+          + "; ".join(problems))
 
   def _train_loop(self, init_state, train_step, eval_step, broadcast_init,
                   init_rng, next_batch, train_chunk=None) -> Dict[str, Any]:
@@ -763,10 +823,26 @@ class BenchmarkCNN:
     resumed = False
     if p.train_dir:
       try:
-        path, ckpt_step = checkpoint.latest_checkpoint(p.train_dir)
+        # Parse-once resolve that skips torn/corrupt files with a
+        # logged warning (checkpoint.load_latest_checkpoint).
+        snapshot, path, ckpt_step = checkpoint.load_latest_checkpoint(
+            p.train_dir)
         state = checkpoint.restore_state(
-            state, checkpoint.load_checkpoint(path),
-            sharded_opt_state=self._sharded_state)
+            state, snapshot, sharded_opt_state=self._sharded_state)
+        # Cross-topology resumes (a sharded checkpoint written at a
+        # different mesh re-slices in restore_state) re-verify the
+        # structural contract exactly like an in-run rescale.
+        self._verify_resumed_state(state)
+        # Reopen the input stream at the snapshot's incarnation: a
+        # rejoin after an elastic reshape must continue the POST-resize
+        # stream, not silently reset to stream 0.
+        snap_inc = int(snapshot.get("input_incarnation", 0) or 0)
+        if snap_inc != getattr(self, "_input_incarnation", 0):
+          self._input_incarnation = snap_inc
+          next_batch = self._open_input(self._data_rng, "train",
+                                        bump=False)
+          images, labels = next_batch()
+          log_fn(f"Resumed input stream at incarnation {snap_inc}")
         log_fn(f"Restored checkpoint at global step {ckpt_step}")
         resumed = True
       except checkpoint.CheckpointNotFoundException:
@@ -1109,10 +1185,13 @@ class BenchmarkCNN:
       return bool((controller is not None or batch_policy is not None) and
                   s % p.elastic_check_every_n_steps == 0)
 
+    def _fault_due(s: int) -> bool:
+      return self._faults is not None and self._faults.due(s)
+
     def _event_due(s: int) -> bool:
       """A host intervention is scheduled immediately after step ``s``."""
       return (_save_steps_due(s) or _eval_sched_due(s) or
-              _elastic_sched_due(s))
+              _elastic_sched_due(s) or _fault_due(s))
 
     def _dispatch_len(done_steps: int) -> int:
       """Length of the next dispatch: up to K steps, stopping at the run
@@ -1131,6 +1210,10 @@ class BenchmarkCNN:
     # timed loop's per-dispatch host-overhead average.
     dispatch_stats["call_times"].clear()
     i = 0  # steps completed (cursor carries over from warmup)
+    # Injected drop_msg (faults.py) is STICKY: the fault may fire at a
+    # non-poll boundary, and what it must suppress is the NEXT actual
+    # coordination poll -- consumed there, not at its own step.
+    drop_next_poll = False
     while i < self.num_batches:
       n_dispatch = _dispatch_len(i) if chunked else 1
       if chunked and not synthetic and cursor:
@@ -1177,19 +1260,31 @@ class BenchmarkCNN:
           time.time() - last_save_time >= p.save_model_secs)
       eval_due = _eval_sched_due(i)
       elastic_due = _elastic_sched_due(i)
-      if save_due or eval_due or elastic_due:
+      fault_due = _fault_due(i)
+      if save_due or eval_due or elastic_due or fault_due:
         # Sync point: resolve everything in flight so checkpoint/eval/
         # resize wall time stays out of the per-step timing, then exclude
         # it from the next interval via note_aux_time.
         for done in pipe.flush():
           _handle(done)
         aux_start = time.time()
+        if fault_due:
+          # Faults fire FIRST at the boundary (a preemption does not
+          # wait for the checkpoint cadence): kill/sigterm never
+          # return; corrupt_ckpt truncates the newest snapshot already
+          # ON DISK (i.e. before this boundary's own save lands); the
+          # recorder row is written BEFORE firing so a kill still
+          # leaves its trace in the continuous window.
+          if tele is not None:
+            for f in self._faults.peek_due(i):
+              tele.fault_event(f.describe(), i)
+          fired = self._faults.fire_due(i, train_dir=p.train_dir)
+          if fired.dropped_message:
+            drop_next_poll = True
         if save_due:
           # Periodic checkpoint by steps (ref: benchmark_cnn.py:2304-2309)
           # or seconds (ref: Supervisor save_model_secs, :2137).
-          checkpoint.save_checkpoint(p.train_dir, state,
-                                     p.max_ckpts_to_keep,
-                                     sharded_opt_state=self._sharded_state)
+          self._save_checkpoint(state)
           last_save_time = time.time()
         if eval_due:
           # Mid-training eval + early stop (ref: benchmark_cnn.py:2310-2324).
@@ -1210,7 +1305,15 @@ class BenchmarkCNN:
           new_n = None
           restart_np = None
           under_kfrun = "KFCOORD_WORLD" in os.environ
-          if controller is not None:
+          if controller is not None and drop_next_poll:
+            # Injected drop_msg (faults.py): this poll is the lost
+            # message. The poll-side dedup never advanced, so a
+            # pending RESIZE must re-surface at the next poll instead
+            # of vanishing (pinned in tests/test_faults.py).
+            drop_next_poll = False
+            log_fn(f"fault drop_msg: coordination poll at step {i} "
+                   "dropped; a pending resize stays pending")
+          elif controller is not None:
             poll_at = getattr(controller, "poll_at", None)
             new_n = poll_at(i) if poll_at else controller.poll()
             raw = getattr(controller, "last_raw_target", None)
@@ -1265,9 +1368,7 @@ class BenchmarkCNN:
             else:
               for done in pipe.flush():
                 _handle(done)
-              checkpoint.save_checkpoint(p.train_dir, state,
-                                         p.max_ckpts_to_keep,
-                                         sharded_opt_state=self._sharded_state)
+              self._save_checkpoint(state)
               log_fn("Elastic restart at step %d: workers %d -> %d "
                      "(checkpoint + re-exec under the launcher)" % (
                          i, max(self.num_workers, 1), restart_np))
@@ -1290,6 +1391,15 @@ class BenchmarkCNN:
                 new_n or self.num_devices)
             if proposed != self.batch_size_per_device:
               new_bs = proposed
+          nm_axis = (int(self.mesh.shape[mesh_lib.MODEL_AXIS])
+                     if mesh_lib.BATCH_AXIS in self.mesh.axis_names else 1)
+          if new_n and new_n % nm_axis:
+            # 2-D family: the model axis survives a resize, so the
+            # target must be a multiple of its width.
+            log_fn(f"Elastic reshape to {new_n} devices rejected: the "
+                   f"model-axis width ({nm_axis}) must divide the "
+                   "target on the 2-D mesh; keeping current topology")
+            new_n = None
           if new_n:
             # A resize must honor the same cross-flag rules as startup
             # (e.g. the async-PS sequential-apply device cap): an
@@ -1298,8 +1408,11 @@ class BenchmarkCNN:
             # hold topology rather than grow into a configuration the
             # CLI would have rejected.
             try:
-              validation.validate_cross_flags(
-                  self.params._replace(num_devices=new_n))
+              check = self.params._replace(num_devices=new_n)
+              if check.mesh_shape:
+                check = check._replace(
+                    mesh_shape=f"{new_n // nm_axis}x{nm_axis}")
+              validation.validate_cross_flags(check)
             except validation.ParamError as e:
               log_fn(f"Elastic reshape to {new_n} devices rejected by "
                      f"flag validation ({e}); keeping current topology")
@@ -1315,6 +1428,18 @@ class BenchmarkCNN:
                        i, self.num_devices, event["num_devices"],
                        self.batch_size_per_device,
                        event["batch_size_per_device"]))
+            old_mesh = "x".join(
+                str(int(s)) for s in self.mesh.devices.shape)
+            if p.train_dir:
+              # Drain happened at the sync point above; snapshot to
+              # disk BEFORE the rebuild, so a crash mid-rescale (or a
+              # preemption racing it) resumes from this exact seam --
+              # and a peer run at the new size can start from the same
+              # snapshot (the bit-identity contract of the rescale
+              # tests). incarnation_bump=1: the seam's resume point is
+              # the POST-resize input stream.
+              self._save_checkpoint(state, incarnation_bump=1)
+              last_save_time = time.time()
             state, train_step, eval_step, next_batch, train_chunk = \
                 self._reshape_topology(state, event["num_devices"],
                                        event["batch_size_per_device"],
@@ -1324,6 +1449,25 @@ class BenchmarkCNN:
             images, labels = next_batch()
             cursor = 0
             reshape_events.append(event)
+            # ONE elastic event line (generation, old -> new mesh,
+            # resume step) -- the operator-facing record a preemption
+            # story needs instead of silence -- mirrored into the
+            # flight-recorder window when a telemetry session exists.
+            generation = len(reshape_events)
+            if controller is not None and hasattr(controller,
+                                                  "generation"):
+              try:
+                generation = controller.generation()
+              except Exception:
+                pass
+            new_mesh = "x".join(
+                str(int(s)) for s in self.mesh.devices.shape)
+            event["mesh"] = f"{old_mesh}->{new_mesh}"
+            log_fn("elastic event: generation %d: mesh %s -> %s, "
+                   "resume step %d" % (generation, old_mesh, new_mesh,
+                                       i))
+            if tele is not None:
+              tele.elastic_event(generation, old_mesh, new_mesh, i)
         pipe.note_aux_time(time.time() - aux_start)
     for done in pipe.flush():
       _handle(done)
@@ -1393,8 +1537,7 @@ class BenchmarkCNN:
                     "watchdog_stalls": health_summary["watchdog_stalls"]})
     # Final checkpoint (ref: benchmark_cnn.py:2374-2378).
     if p.train_dir:
-      checkpoint.save_checkpoint(p.train_dir, state, p.max_ckpts_to_keep,
-                                 sharded_opt_state=self._sharded_state)
+      self._save_checkpoint(state)
     if p.sync_on_finish:
       kungfu.run_barrier()
     # (ref stats dict: benchmark_cnn.py:2383-2391)
@@ -1515,9 +1658,8 @@ class BenchmarkCNN:
     if custom_eval is not None and not self.dataset.use_synthetic_gpu_inputs():
       if p.train_dir:
         try:
-          path, _ = checkpoint.latest_checkpoint(p.train_dir)
-          state = checkpoint.restore_state(state,
-                                           checkpoint.load_checkpoint(path),
+          snapshot, _, _ = checkpoint.load_latest_checkpoint(p.train_dir)
+          state = checkpoint.restore_state(state, snapshot,
                                            restore_opt_state=False)
         except checkpoint.CheckpointNotFoundException:
           pass
@@ -1571,10 +1713,16 @@ class BenchmarkCNN:
         continue
       if ckpt_step > last_evaluated_step:
         try:
-          snapshot = checkpoint.load_checkpoint(path)
-        except FileNotFoundError:
-          # The trainer pruned this checkpoint between resolution and
-          # read; treat as not-yet-available and re-poll.
+          # Parse-once + torn-file skip; the resolve above stays cheap
+          # (no parse) for the common nothing-new poll.
+          snapshot, path, ckpt_step = checkpoint.load_latest_checkpoint(
+              p.train_dir)
+        except checkpoint.CheckpointNotFoundException:
+          snapshot = None
+        if snapshot is None or ckpt_step <= last_evaluated_step:
+          # The newest checkpoint was pruned between resolution and
+          # read, or is torn with nothing newer behind it: treat as
+          # not-yet-available and re-poll.
           stale_polls += 1
           if stale_polls >= max_stale_polls:
             return results

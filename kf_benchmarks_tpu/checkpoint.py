@@ -68,7 +68,8 @@ def _replica0_local(x):
   return np.asarray(x[0])
 
 
-def savable_state(state, sharded_opt_state: bool = False) -> dict:
+def savable_state(state, sharded_opt_state: bool = False,
+                  input_incarnation: int = 0) -> dict:
   """Host-side, mode-invariant snapshot: replica-0 slice of the stacked
   arrays + replicated scalars (ref: variable_mgr savable_variables).
 
@@ -93,18 +94,26 @@ def savable_state(state, sharded_opt_state: bool = False) -> dict:
   }
   if sharded_opt_state:
     snap["opt_state_layout"] = "sharded"
+  if input_incarnation:
+    # The input-stream incarnation the RESUMED run must reopen at
+    # (benchmark._open_input folds the data rng by it after elastic
+    # reshapes): without this, a preemption after a resize would
+    # silently reset the rejoined run to stream 0.
+    snap["input_incarnation"] = int(input_incarnation)
   return snap
 
 
 def save_checkpoint(train_dir: str, state, max_to_keep: int = 5,
-                    sharded_opt_state: bool = False) -> str:
+                    sharded_opt_state: bool = False,
+                    input_incarnation: int = 0) -> str:
   """Write a checkpoint; prune beyond ``max_to_keep``
   (ref: --max_ckpts_to_keep, benchmark_cnn.py:606-608). No-op on
   non-chief processes."""
   if not is_chief():
     return ""
   os.makedirs(train_dir, exist_ok=True)
-  snap = savable_state(state, sharded_opt_state=sharded_opt_state)
+  snap = savable_state(state, sharded_opt_state=sharded_opt_state,
+                       input_incarnation=input_incarnation)
   step = snap["step"]
   fname = f"model.ckpt-{step}.msgpack"
   path = os.path.join(train_dir, fname)
@@ -145,25 +154,81 @@ def all_checkpoints(train_dir: str):
   return sorted(out)
 
 
-def latest_checkpoint(train_dir: str) -> Tuple[str, int]:
-  """Resolve the newest checkpoint; the step is parsed from the filename
-  (ref: benchmark_cnn.py:911-924). Raises CheckpointNotFoundException."""
-  # Prefer the index file; fall back to a directory scan (a missing or
-  # stale index must not orphan valid checkpoints).
+def readable_checkpoint(path: str) -> bool:
+  """Whether ``path`` holds a complete, parseable snapshot. Writes are
+  atomic (tmp + os.replace in save_checkpoint), so a torn file can only
+  come from outside the save protocol -- a copy killed mid-transfer, a
+  truncated disk, an injected corrupt_ckpt fault (faults.py) -- and the
+  msgpack parse is the cheap whole-file integrity check."""
+  try:
+    load_checkpoint(path)
+    return True
+  except Exception:
+    return False
+
+
+def _candidates(train_dir: str):
+  """(step, fname) candidates newest-first: the index target first (when
+  valid), then the directory scan -- a missing/stale index must not
+  orphan valid checkpoints, and a corrupt index target must not mask
+  the older snapshots behind it."""
+  candidates = []
   try:
     with open(_index_path(train_dir)) as f:
       fname = json.load(f)["latest"]
     m = _CKPT_RE.match(fname)
     if m and os.path.exists(os.path.join(train_dir, fname)):
-      return os.path.join(train_dir, fname), int(m.group(1))
+      candidates.append((int(m.group(1)), fname))
   except (FileNotFoundError, json.JSONDecodeError, KeyError):
     pass
-  ckpts = all_checkpoints(train_dir)
-  if not ckpts:
+  for step, fname in reversed(all_checkpoints(train_dir)):
+    if (step, fname) not in candidates:
+      candidates.append((step, fname))
+  candidates.sort(reverse=True)
+  return candidates
+
+
+def latest_checkpoint(train_dir: str) -> Tuple[str, int]:
+  """Resolve the newest checkpoint path; the step is parsed from the
+  filename (ref: benchmark_cnn.py:911-924). Cheap (no file parse):
+  pollers call this every staleness interval. Restore paths that must
+  survive a torn file go through :func:`load_latest_checkpoint`, which
+  parses exactly once and skips corrupt files."""
+  candidates = _candidates(train_dir)
+  if not candidates:
     raise CheckpointNotFoundException(
         f"No checkpoint found in {train_dir}")
-  step, fname = ckpts[-1]
+  step, fname = candidates[0]
   return os.path.join(train_dir, fname), step
+
+
+def load_latest_checkpoint(train_dir: str):
+  """(snapshot, path, step) of the newest READABLE checkpoint.
+  Torn/corrupt files are skipped with a logged warning (a partial file
+  -- a copy killed mid-transfer, an injected corrupt_ckpt fault; the
+  save protocol itself is atomic tmp + os.replace -- must never poison
+  resume: the run falls back to the previous snapshot). The msgpack
+  parse doubles as the whole-file integrity check and the snapshot is
+  parsed exactly ONCE (callers restore from the returned dict).
+  Raises CheckpointNotFoundException."""
+  from kf_benchmarks_tpu.utils import log as log_util
+  candidates = _candidates(train_dir)
+  skipped = 0
+  for step, fname in candidates:
+    path = os.path.join(train_dir, fname)
+    try:
+      return load_checkpoint(path), path, step
+    except Exception:
+      skipped += 1
+      log_util.log_fn(
+          f"Warning: skipping torn/corrupt checkpoint {fname} "
+          "(unparseable msgpack); resuming from the previous snapshot")
+  if not candidates:
+    raise CheckpointNotFoundException(
+        f"No checkpoint found in {train_dir}")
+  raise CheckpointNotFoundException(
+      f"No readable checkpoint in {train_dir} "
+      f"({skipped} corrupt file(s) skipped)")
 
 
 def load_checkpoint(path: str) -> dict:
@@ -197,9 +262,14 @@ def restore_state(state, snapshot: dict, restore_opt_state: bool = True,
 
   Snapshots marked ``opt_state_layout == 'sharded'`` carry the FULL
   stacked shard arrays (see savable_state); they restore only into a
-  state whose opt_state has the same sharded layout, and a layout
-  mismatch in either direction raises (re-slicing 1/n flat shards into
-  the other layout silently would corrupt the optimizer state)."""
+  state whose opt_state is also sharded -- a sharded<->replicated
+  layout mismatch raises in either direction (re-slicing 1/n flat
+  shards into the other layout silently would corrupt the optimizer
+  state). A sharded snapshot written at a DIFFERENT shard count
+  re-slices onto the live topology (``_reshard``): both layouts are the
+  zero-padded row-major flatten of the same full state, so the rescale
+  is exact -- the cross-mesh elastic-resume leg (ROADMAP item 3),
+  replacing the round-11 cross-layout rejection."""
   snap_sharded = snapshot.get("opt_state_layout") == "sharded"
   if restore_opt_state and snap_sharded != sharded_opt_state:
     raise ValueError(
@@ -288,21 +358,46 @@ def restore_backbone(state, path: str):
 
 
 def _reshard(template, host_tree):
-  """Restore a FULL stacked shard tree (savable_state sharded layout):
-  every saved ``(n, k)`` array lands whole -- row i is device i's shard
-  again -- instead of the v0 broadcast. Shape equality against the live
-  template is the topology check: a shard tree saved at a different n
-  cannot be resliced here (the checkpointed-rescale leg, ROADMAP)."""
+  """Restore a FULL stacked shard tree (savable_state sharded layout)
+  onto the live topology.
+
+  Same shard count: every saved ``(n, k)`` array lands whole -- row i
+  is device i's shard again -- instead of the v0 broadcast.
+
+  Different shard count (the cross-mesh elastic rescale): the stacked
+  layout is, by construction (ops/sharded.py stacked_shards), the
+  row-major zero-padded flatten of the full state tensor -- so the
+  saved ``(n, k)`` stack flattens back to the padded vector, is
+  re-padded/truncated to the live ``n' * k'`` total (only zero pad is
+  ever cut: ``n' * ceil(size / n') >= size`` for every ``n'``), and
+  reshaped ``(n', k')``. Bit-exact: no shard value is recomputed, only
+  re-addressed. Per-shard SCALAR leaves (optax schedule counts, shape
+  ``(n,)`` under the vmap'd init) are replica-identical by construction
+  -- every shard applies once per step -- so row 0 broadcasts to
+  ``(n',)``."""
   host_state = serialization.from_state_dict(
       jax.tree.map(np.asarray, template), host_tree)
 
   def place(t, h):
     h = np.asarray(h)
-    if tuple(h.shape) != tuple(t.shape):
-      raise ValueError(
-          f"sharded opt_state leaf shape {h.shape} != live {t.shape}: "
-          "the checkpoint was written at a different shard count")
-    return jnp.asarray(h, t.dtype)
+    if tuple(h.shape) == tuple(t.shape):
+      return jnp.asarray(h, t.dtype)
+    if h.ndim == 1 and t.ndim == 1:
+      # Stacked per-shard scalars: rows identical, re-stack to n'.
+      return jnp.broadcast_to(jnp.asarray(h[0], t.dtype), t.shape)
+    if h.ndim == 2 and t.ndim == 2:
+      flat = h.reshape(-1)
+      need = int(t.shape[0]) * int(t.shape[1])
+      if need <= flat.size:
+        flat = flat[:need]
+      else:
+        flat = np.pad(flat, (0, need - flat.size))
+      return jnp.asarray(flat.reshape(tuple(t.shape)), t.dtype)
+    raise ValueError(
+        f"sharded opt_state leaf shape {h.shape} cannot be resliced "
+        f"onto live {tuple(t.shape)}: only stacked (n, k) shard rows "
+        "and (n,) per-shard scalars have a defined cross-topology "
+        "layout (ops/sharded.py)")
 
   return jax.tree.map(place, template, host_state)
 

@@ -126,9 +126,18 @@ def _run_generation(server, np_: int, command: List[str], logdir: str,
 def launch(np_: int, command: List[str], logdir: str = ".",
            host: str = "127.0.0.1", base_port: int = 0,
            extra_env: Optional[dict] = None,
-           max_restarts: int = 16) -> int:
+           max_restarts: int = 16,
+           restart_on_failure: bool = False) -> int:
   """Start coordinator + N workers; relaunch on coordinated restarts;
-  return the final generation's worst exit code."""
+  return the final generation's worst exit code.
+
+  ``restart_on_failure`` adds preemption survival (the kill/rejoin
+  leg): a generation where any worker died abnormally -- SIGKILL'd by
+  a preemptor, OOM-killed, crashed -- is relaunched at the SAME world
+  size instead of failing the job, and the rejoined workers resume
+  from the checkpoint in ``--train_dir`` (KungFu's config-server
+  rejoin, SURVEY 2.9, rendered as checkpointed restart). Bounded by
+  ``max_restarts`` so a deterministic crash loop still terminates."""
   from kf_benchmarks_tpu.parallel import coordination
 
   server = coordination.CoordinatorServer(port=base_port)
@@ -140,7 +149,17 @@ def launch(np_: int, command: List[str], logdir: str = ".",
                                       host, extra_env,
                                       opened_logs=opened_logs)
       if not restart:
-        return code
+        # 130 = KeyboardInterrupt teardown: the operator asked the job
+        # to stop; survival must not resurrect it.
+        if code in (0, 130) or not restart_on_failure:
+          return code
+        # Abnormal worker death with survival enabled: rejoin at the
+        # same world size from the last checkpoint. No resize was
+        # agreed, so the scheduled-restart key is not consulted.
+        print(f"kfrun: worker died (exit {code}); rejoining "
+              f"np={gen_np} from the last checkpoint",
+              file=sys.stderr, flush=True)
+        continue
       # The workers checkpointed and exited for a resize; relaunch at
       # the PROCESS count they agreed on in the scheduled-restart key
       # (the raw RESIZE target is a global DEVICE count -- with >1
@@ -181,6 +200,11 @@ def main(argv=None):
   parser.add_argument("--host", default="127.0.0.1")
   parser.add_argument("--port", type=int, default=0,
                       help="coordinator port (0 = ephemeral)")
+  parser.add_argument("--restart-on-failure", action="store_true",
+                      dest="restart_on_failure",
+                      help="relaunch the world at the same size when a "
+                           "worker dies abnormally (preemption "
+                           "survival; workers resume from --train_dir)")
   parser.add_argument("command", nargs=argparse.REMAINDER,
                       help="worker command (prefix with --)")
   args = parser.parse_args(argv)
@@ -190,7 +214,8 @@ def main(argv=None):
   if not command:
     parser.error("no worker command given")
   sys.exit(launch(args.np_, command, logdir=args.logdir, host=args.host,
-                  base_port=args.port))
+                  base_port=args.port,
+                  restart_on_failure=args.restart_on_failure))
 
 
 if __name__ == "__main__":

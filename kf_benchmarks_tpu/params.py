@@ -276,6 +276,16 @@ flags.DEFINE_boolean("elastic", False,
 flags.DEFINE_integer("elastic_check_every_n_steps", 10,
                      "How often the train loop polls for elastic resize / "
                      "adaptive-batch decisions.", lower_bound=1)
+flags.DEFINE_string("fault_schedule", None,
+                    "Deterministic fault injection (faults.py): "
+                    "comma-separated <kind>@<step>[:rank=R][:secs=S] "
+                    "entries with kind in kill | sigterm | "
+                    "heartbeat_delay | drop_msg | corrupt_ckpt; each "
+                    "fires ONCE at the dispatch boundary after the "
+                    "named step (one-shot across checkpoint-restart "
+                    "generations via train_dir markers). The "
+                    "reproducible-preemption harness behind the "
+                    "kill/rejoin tests; no reference analog.")
 flags.DEFINE_boolean("adaptive_batch_size", False,
                      "Adapt the per-device batch size to the measured "
                      "gradient noise scale (implies "

@@ -204,9 +204,9 @@ def resolve_health_stats(params, strategy=None):
         params, "benchmark_log_dir", None):
       return False, (
           "health_stats: --shard_optimizer_state applies the optimizer "
-          "on per-device state shards; the full-tree in-step stats "
-          "(and with them the flight recorder/watchdog session) are "
-          "disabled")
+          "on per-device state shards; the full-tree in-step stats are "
+          "disabled (elastic/fault-injected runs with a train_dir keep "
+          "their flight-recorder/watchdog session regardless)")
     return False, None
   if strategy is not None:
     cross = bool(getattr(strategy, "cross_replica", False))
@@ -422,6 +422,18 @@ class FlightRecorder:
 
   def tail(self, n: int = 3) -> List[dict]:
     return list(self._records)[-n:]
+
+  def note_event(self, event: Dict[str, Any]) -> dict:
+    """Append a non-step event record (elastic resize, injected fault)
+    to the ring + continuous window -- the post-mortem that follows a
+    preemption must show WHAT the run was doing, not just its losses.
+    Events bypass anomaly detection (they are operator actions, not
+    training signals)."""
+    rec = {"rank": self.rank}
+    rec.update(event)
+    self._records.append(rec)
+    self._write_window()
+    return rec
 
   # -- dumps ----------------------------------------------------------------
 
@@ -643,8 +655,17 @@ class TelemetrySession:
   def create(cls, params, rank: int = 0, log_fn=None,
              num_ranks: int = 1) -> Optional["TelemetrySession"]:
     """None unless the run's resolved --health_stats is on (benchmark
-    resolves auto -> bool before building the step)."""
-    if not getattr(params, "health_stats", None):
+    resolves auto -> bool before building the step) -- OR the run is
+    elastic/fault-injected with a train_dir sink: a preemption must
+    produce a flight-recorder post-mortem window and a recorded elastic
+    event even when the in-step stats are off (e.g.
+    --shard_optimizer_state auto-disables them). The recorder and
+    watchdog are host-side only, so this changes no compiled program."""
+    wants = bool(getattr(params, "health_stats", None)) or (
+        bool(getattr(params, "train_dir", None)) and
+        (bool(getattr(params, "elastic", False)) or
+         bool(getattr(params, "fault_schedule", None))))
+    if not wants:
       return None
     return cls(params, rank=rank, log_fn=log_fn, num_ranks=num_ranks)
 
@@ -672,6 +693,22 @@ class TelemetrySession:
 
   def record(self, **kwargs) -> None:
     self.recorder.record(**kwargs)
+
+  def elastic_event(self, generation: int, old_mesh: str, new_mesh: str,
+                    step: int) -> None:
+    """One recorder row per resize (benchmark.py logs the matching
+    single line): the post-mortem window shows generation, old -> new
+    mesh and the resume step instead of an unexplained loss-curve
+    seam."""
+    self.recorder.note_event({
+        "elastic_event": f"{old_mesh}->{new_mesh}",
+        "generation": int(generation),
+        "step": int(step),
+    })
+
+  def fault_event(self, description: str, step: int) -> None:
+    self.recorder.note_event({"fault_event": description,
+                              "step": int(step)})
 
   def summary(self) -> Dict[str, Any]:
     s = self.recorder.summary()

@@ -67,7 +67,6 @@ NO_CROSS_FLAG_VALIDATION = {
     "benchmark_test_id": "artifact metadata string",
     "eval_dir": "artifact sink path",
     "eval_interval_secs": "eval-loop cadence only",
-    "train_dir": "artifact sink path (checkpoints/recorder)",
     "save_summaries_steps": "summary cadence only",
     "summary_verbosity": "summary tier selector (observability.py caps)",
     "loss_type_to_report": "display column selector",
@@ -108,7 +107,6 @@ NO_CROSS_FLAG_VALIDATION = {
     # engagement is validated through health_stats above.
     "health_grad_norm_sigma": "anomaly threshold (registry bounds only)",
     "flight_recorder_window": "ring size (registry bounds only)",
-    "stall_watchdog_factor": "watchdog threshold; 0 disables",
     "elastic_check_every_n_steps": "resize-poll cadence only",
     # Cluster wiring: free-form host lists/ids consumed by cluster.py;
     # the modes that REQUIRE them are validated via job_name above.
@@ -332,14 +330,11 @@ def validate_cross_flags(params) -> None:
             "each reducer owns the reduction granularity (ref: "
             "batch_allreduce.py:300-317 selects one algorithm); the "
             "sharded path's reduction IS the per-leaf reduce-scatter")
-    if p.elastic:
-      raise ParamError(
-          "--shard_optimizer_state cannot be combined with --elastic: "
-          "a resize changes the shard count, and the in-mesh reshape "
-          "path restores state across topologies by replica-0 "
-          "broadcast (benchmark.py _reshape_topology) -- resharding "
-          "1/n flat shards onto a different n is ROADMAP item 3's "
-          "checkpointed-rescale leg, not wired yet")
+    # --elastic composes since the cross-mesh rescale landed: a resize
+    # re-slices the saved (n, k) shard stack onto the new topology
+    # (checkpoint.py _reshard), preserving the model-axis width -- a
+    # target the model axis does not divide is rejected at poll time,
+    # not here (the target is only known mid-run).
     if p.health_stats:
       raise ParamError(
           "--health_stats cannot be combined with "
@@ -347,6 +342,45 @@ def validate_cross_flags(params) -> None:
           "per-step update tree (telemetry.py health_partials), and "
           "the sharded apply only materializes this device's 1/n "
           "update shard. Drop the flag (auto-off with a note)")
+  if getattr(p, "fault_schedule", None):
+    # Malformed schedules fail at startup, not at the named step: a
+    # fault harness that silently skips its fault proves nothing.
+    from kf_benchmarks_tpu import faults
+    try:
+      entries = faults.parse_schedule(p.fault_schedule)
+    except faults.FaultScheduleError as e:
+      raise ParamError(str(e))
+    if any(f.kind == "corrupt_ckpt" for f in entries) and not p.train_dir:
+      raise ParamError(
+          "--fault_schedule=corrupt_ckpt@... requires --train_dir: "
+          "there is no checkpoint to corrupt without one")
+    if any(f.kind in ("kill", "sigterm") for f in entries) \
+        and not p.train_dir:
+      raise ParamError(
+          "--fault_schedule kill/sigterm entries require --train_dir: "
+          "the one-shot-across-generations marker lives there "
+          "(faults.py) -- without it every relaunched generation "
+          "re-kills itself at the same step, and there is no "
+          "checkpoint to rejoin from anyway")
+    if any(f.kind == "drop_msg" for f in entries) and not p.elastic:
+      raise ParamError(
+          "--fault_schedule=drop_msg@... requires --elastic: the fault "
+          "suppresses a coordination-service poll, and without elastic "
+          "polling there is no message to drop -- the injection would "
+          "log success while testing nothing")
+    if any(f.kind == "heartbeat_delay" for f in entries) and (
+        not p.stall_watchdog_factor or
+        not (p.train_dir or p.health_stats)):
+      raise ParamError(
+          "--fault_schedule=heartbeat_delay@... requires a live stall "
+          "watchdog to starve: --stall_watchdog_factor > 0 plus a "
+          "telemetry session (--train_dir, or explicit --health_stats) "
+          "-- otherwise the injected silence is observed by nothing")
+    if p.eval or p.forward_only:
+      raise ParamError(
+          "--fault_schedule applies to training runs only (the faults "
+          "fire at train-dispatch boundaries); it cannot be combined "
+          "with --eval or --forward_only")
   if (p.adaptive_batch_size and
       p.adaptive_batch_min > p.adaptive_batch_max):
     raise ParamError(

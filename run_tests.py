@@ -50,6 +50,7 @@ DISTRIBUTED_TESTS = [
     "tests/test_elastic_process.py",
     "tests/test_elastic_restart.py",
     "tests/test_kfrun.py",
+    "tests/test_kill_rejoin.py",
 ]
 
 # Long-running suites excluded from the fast default (whole-zoo model

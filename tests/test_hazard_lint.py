@@ -174,6 +174,83 @@ def test_timeout_outside_tests_dir_not_this_rules_business(tmp_path, empty_allow
   assert not _rules(tmp_path, "kill-timeout")
 
 
+# -- signal-chain -------------------------------------------------------------
+
+UNCHAINED = ("import signal\n\n"
+             "def install(handler):\n"
+             "  signal.signal(signal.SIGTERM, handler)\n")
+
+
+def test_unchained_signal_registration_seeded(tmp_path, monkeypatch):
+  monkeypatch.setattr(lint, "SIGNAL_CHAIN_ALLOWLIST", {})
+  _seed(tmp_path, "kf_benchmarks_tpu/rogue_signals.py", UNCHAINED)
+  violations = _rules(tmp_path, "signal-chain")
+  assert [v.path for v in violations] == [
+      "kf_benchmarks_tpu/rogue_signals.py"]
+  assert violations[0].line == 4 and "chain" in violations[0].message
+  assert lint.main(["--root", str(tmp_path),
+                    "--rules", "signal-chain"]) == 1
+
+
+def test_chained_signal_registration_clean(tmp_path, monkeypatch):
+  # The compliant twin captures the previous handler (the chaining
+  # contract telemetry.py's handlers follow).
+  monkeypatch.setattr(lint, "SIGNAL_CHAIN_ALLOWLIST", {})
+  _seed(tmp_path, "kf_benchmarks_tpu/ok_signals.py",
+        "import signal\n\n"
+        "def install(handler):\n"
+        "  old = signal.signal(signal.SIGTERM, handler)\n"
+        "  return old\n")
+  assert not _rules(tmp_path, "signal-chain")
+
+
+def test_signal_registration_allowed_in_homes(tmp_path, monkeypatch):
+  monkeypatch.setattr(lint, "SIGNAL_CHAIN_ALLOWLIST", {})
+  _seed(tmp_path, "kf_benchmarks_tpu/telemetry.py", UNCHAINED)
+  _seed(tmp_path, "kf_benchmarks_tpu/faults.py", UNCHAINED)
+  assert not _rules(tmp_path, "signal-chain")
+
+
+def test_direct_import_form_caught(tmp_path, monkeypatch):
+  # `from signal import signal` must not evade the rule.
+  monkeypatch.setattr(lint, "SIGNAL_CHAIN_ALLOWLIST", {})
+  _seed(tmp_path, "kf_benchmarks_tpu/direct.py",
+        "from signal import signal, SIGTERM\n\n"
+        "def install(handler):\n"
+        "  signal(SIGTERM, handler)\n")
+  violations = _rules(tmp_path, "signal-chain")
+  assert [v.line for v in violations] == [4]
+  # ...including aliased imports, of the function AND of the module.
+  _seed(tmp_path, "kf_benchmarks_tpu/direct.py",
+        "from signal import signal as sig\n\n"
+        "def install(handler):\n"
+        "  sig(2, handler)\n")
+  assert _rules(tmp_path, "signal-chain")
+  _seed(tmp_path, "kf_benchmarks_tpu/direct.py",
+        "import signal as sig\n\n"
+        "def install(handler):\n"
+        "  sig.signal(sig.SIGTERM, handler)\n")
+  assert _rules(tmp_path, "signal-chain")
+
+
+def test_non_signal_module_signal_attr_not_a_registration(tmp_path,
+                                                          monkeypatch):
+  # p.send_signal(...) / custom .signal(...) methods are not handler
+  # registrations (kfrun.py's teardown is the in-repo example).
+  monkeypatch.setattr(lint, "SIGNAL_CHAIN_ALLOWLIST", {})
+  _seed(tmp_path, "kf_benchmarks_tpu/proc.py",
+        "def stop(p):\n  p.send_signal(15)\n  p.bus.signal('x')\n")
+  assert not _rules(tmp_path, "signal-chain")
+
+
+def test_signal_chain_allowlist_staleness(tmp_path, monkeypatch):
+  monkeypatch.setattr(lint, "SIGNAL_CHAIN_ALLOWLIST",
+                      {"kf_benchmarks_tpu/clean.py": "test reason"})
+  _seed(tmp_path, "kf_benchmarks_tpu/clean.py", "X = 1\n")
+  violations = _rules(tmp_path, "signal-chain")
+  assert len(violations) == 1 and "stale" in violations[0].message
+
+
 # -- step-line-format ---------------------------------------------------------
 
 def test_second_step_line_literal_seeded(tmp_path):

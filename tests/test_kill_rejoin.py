@@ -1,0 +1,155 @@
+"""Kill-and-rejoin survival: a SIGKILL'd worker's rejoin cycle
+converges to the synchronous envelope (ROADMAP item 3's proof
+obligation; template: the gossip-vs-sync envelope A/B).
+
+A 2-worker kfrun job trains with a deterministic preemption injected
+(--fault_schedule=kill@10:rank=1, faults.py): worker 1 SIGKILLs itself
+mid-run, kfrun's --restart-on-failure leg relaunches the SAME world
+size, and both workers resume from the chief's periodic checkpoint --
+the fired-fault marker in train_dir keeps the kill from re-firing on
+the replay. The killed-and-rejoined run's loss trajectory must land in
+the envelope of an UNINTERRUPTED synchronous run of the same seed and
+global batch (the same 5%-of-scale + absolute-floor envelope as the
+gossip A/B): preemption may cost repeated steps, never training
+quality.
+
+Timeout-free per the hazard lint: waits are deadline loops that poll
+the appended log, never kill-based subprocess timeouts.
+"""
+
+import os
+import re
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tests.test_distributed_training import _free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STEP_LOSS_RE = re.compile(
+    r"^\d+\timages/sec: [\d.]+ \+/- [\d.]+ \(jitter = [\d.]+\)\t([\d.]+)",
+    re.M)
+
+STEPS = 24
+
+
+def _sync_reference_losses():
+  """The synchronous envelope: an uninterrupted in-process run of the
+  same seed/model/global batch (2 data replicas, pmean-reduced)."""
+  from kf_benchmarks_tpu import benchmark, params as params_lib
+  from kf_benchmarks_tpu.utils import log as log_util
+  logs = []
+  orig = log_util.log_fn
+  log_util.log_fn = logs.append
+  try:
+    p = params_lib.make_params(
+        model="resnet20", data_name="cifar10", device="cpu",
+        num_devices=2, variable_update="kungfu",
+        kungfu_option="sync_sgd", batch_size=2, num_batches=STEPS,
+        num_warmup_batches=1, display_every=1, init_learning_rate=0.01)
+    benchmark.BenchmarkCNN(p).run()
+  finally:
+    log_util.log_fn = orig
+  return [float(m) for m in STEP_LOSS_RE.findall("\n".join(logs))]
+
+
+@pytest.mark.slow
+def test_sigkilled_worker_rejoin_converges_to_sync_envelope(tmp_path):
+  from kf_benchmarks_tpu import kfrun
+
+  coord_port = _free_port()
+  worker_hosts = f"127.0.0.1:{_free_port()},127.0.0.1:{_free_port()}"
+  logdir = str(tmp_path / "logs")
+  train_dir = str(tmp_path / "train")
+  os.makedirs(logdir)
+  worker_cmd = [
+      sys.executable, "-m", "kf_benchmarks_tpu.cli",
+      "--model=resnet20", "--data_name=cifar10",
+      "--device=cpu", "--num_devices=1",
+      "--variable_update=kungfu", "--kungfu_option=sync_sgd",
+      "--batch_size=2", f"--num_batches={STEPS}",
+      "--num_warmup_batches=1", "--display_every=1",
+      "--init_learning_rate=0.01", "--save_model_steps=4",
+      "--fault_schedule=kill@10:rank=1",
+      f"--train_dir={train_dir}", f"--worker_hosts={worker_hosts}",
+  ]
+  env = {
+      "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+      "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+  }
+  result = {}
+
+  def _run():
+    result["code"] = kfrun.launch(2, worker_cmd, logdir=logdir,
+                                  base_port=coord_port, extra_env=env,
+                                  restart_on_failure=True)
+
+  t = threading.Thread(target=_run)
+  t.start()
+  chief_log = os.path.join(logdir, "127.0.0.1.10000.stdout.log")
+  peer_log = os.path.join(logdir, "127.0.0.1.10001.stdout.log")
+
+  def _read(path) -> str:
+    try:
+      with open(path) as f:
+        return f.read()
+    except FileNotFoundError:
+      return ""
+
+  def _wait(pattern, deadline_s, msg, path=chief_log, count=1):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+      if len(re.findall(pattern, _read(path), re.M)) >= count:
+        return
+      if not t.is_alive():
+        break
+      time.sleep(0.5)
+    assert len(re.findall(pattern, _read(path), re.M)) >= count, (
+        msg, _read(path))
+
+  try:
+    # Generation 0 stepped, worker 1 injected its own preemption.
+    _wait(r"^\d+\timages/sec", 300, "gen0 never produced a step line")
+    _wait(r"fault injected: kill at step 10 \(rank 1\)", 300,
+          "the kill fault never fired", path=peer_log)
+    # The rejoined generation restored the chief's snapshot and got
+    # back into its own timed loop (second warmup line in the log).
+    _wait(r"Restored checkpoint at global step \d+", 300,
+          "the rejoined generation never restored")
+    _wait(r"Warmup \(compile", 300,
+          "the rejoined generation never got through warmup", count=2)
+  finally:
+    t.join(timeout=600)
+  assert not t.is_alive(), "kfrun did not finish"
+  assert result.get("code") == 0, _read(chief_log)
+
+  log = _read(chief_log)
+  # The rejoin happened exactly once (one kill, one relaunch).
+  assert len(re.findall(r"Restored checkpoint at global step", log)) == 1
+  restored = int(re.search(
+      r"Restored checkpoint at global step (\d+)", log).group(1))
+  assert restored > 0
+  # The final generation ran to completion on the full world.
+  assert "total images/sec" in log
+
+  losses = [float(m) for m in STEP_LOSS_RE.findall(log)]
+  assert len(losses) >= STEPS, log
+  # The constant synthetic batch makes the loss monotone when (and only
+  # when) the weights actually carried across the kill.
+  third = max(1, len(losses) // 3)
+  assert max(losses[-third:]) < min(losses[:third]) + 1e-6, losses
+
+  # The synchronous envelope: the rejoined run trained at least as far
+  # as the uninterrupted run of the same seed (repeated steps may push
+  # it further; it must never land meaningfully above).
+  ref = _sync_reference_losses()
+  assert len(ref) == STEPS and all(np.isfinite(ref))
+  killed_tail = float(np.mean(losses[-4:]))
+  ref_tail = float(np.mean(ref[-4:]))
+  assert killed_tail <= ref_tail + 0.05 * abs(ref_tail) + 0.05, (
+      f"rejoined run's terminal loss {killed_tail} left the sync "
+      f"envelope around {ref_tail}; killed={losses} sync={ref}")

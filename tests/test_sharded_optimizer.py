@@ -166,7 +166,6 @@ def test_model_axis_requires_sharded_state():
     (dict(gradient_repacking=2), "gradient_repacking"),
     (dict(agg_small_grads_max_bytes=1024), "agg_small_grads_max_bytes"),
     (dict(hierarchical_copy=True), "hierarchical_copy"),
-    (dict(elastic=True), "elastic"),
     (dict(health_stats=True), "health_stats"),
     (dict(num_processes=2), "single-process"),
 ])
@@ -183,6 +182,9 @@ def test_sharded_state_valid_combinations_pass():
              dict(num_grad_accum=2, batch_size=4),
              dict(optimizer="adam"),
              dict(variable_update="parameter_server"),
+             # Round 12: the cross-mesh rescale landed, so elastic
+             # composes (tests/test_elastic_rescale.py pins the resume).
+             dict(elastic=True),
              dict(use_fp16=True, fp16_enable_auto_loss_scale=True)]:
     validation.validate_cross_flags(params_lib.make_params(
         shard_optimizer_state=True, num_devices=8, **kw))
