@@ -128,6 +128,12 @@ def main():
       # actually executed on.
       "mesh_shape": stats.get("mesh_shape"),
       "opt_state_bytes_per_device": stats.get("opt_state_bytes_per_device"),
+      # Input-pipeline health (PR 8): fraction of the loop wall spent
+      # blocked on the host feed. None here -- the resnet bench runs
+      # the resident synthetic batch, which has no feeder -- but the
+      # field rides every BENCH_* line so packed/real-data trajectories
+      # record it uniformly (_CPU_FALLBACK semantics unchanged).
+      "feed_stall_fraction": stats.get("feed_stall_fraction"),
   }
   # Run-health summary (telemetry.py): BENCH_*.json records whether the
   # run was HEALTHY, not just fast -- a throughput number next to

@@ -266,6 +266,51 @@ def rule_sharded_opt_bytes(contract, tracer):
   return []
 
 
+def rule_packed_no_overhead(contract, tracer):
+  """PR 8 (round 13): --packed_sequences must not change the program
+  class. The packed LM still carries no (B, T, V) logits buffer (the
+  btv aux must be present so rule_no_btv_buffer binds -- segment
+  masking must not have detoured through a dense-head path), and the
+  packed step carries NO more collectives than its unpacked twin,
+  kind-for-kind: segment masks are pointwise/tile-local and the
+  token-weighted metric combine PACKS the loss pmeans into one vector
+  (train_step.py), so any count increase is a leak."""
+  if not _cfg(contract, "packed_sequences", False):
+    return []
+  out = []
+  if contract.aux.get("btv_bytes") is None:
+    out.append("packed transformer_lm contract carries no (B, T, V) "
+               "bound aux -- the no-logits rule cannot bind on the "
+               "packed program")
+  if tracer is None:
+    return out
+  twin_cfg = dict(contract.config)
+  twin_cfg.pop("packed_sequences")
+  twin = tracer(twin_cfg, contract.program)
+
+  def counts(c):
+    by_kind: Dict[str, int] = {}
+    for x in c.collectives:
+      by_kind[x.kind] = by_kind.get(x.kind, 0) + 1
+    return by_kind
+
+  on, off = counts(contract), counts(twin)
+  for kind in sorted(on):
+    if on[kind] > off.get(kind, 0):
+      out.append(
+          f"packed step has {on[kind]} {kind}(s) vs {off.get(kind, 0)} "
+          "unpacked -- packing added a collective (the weighted "
+          "metric combine must ride ONE packed vector)")
+  n_grad_on = len(contract.gradient_collectives())
+  n_grad_off = len(twin.gradient_collectives())
+  if n_grad_on != n_grad_off:
+    out.append(
+        f"packed step's gradient collective count {n_grad_on} != "
+        f"unpacked twin's {n_grad_off} -- packing must not touch the "
+        "gradient exchange")
+  return out
+
+
 # -- program-shape invariants (every config) ----------------------------------
 
 def rule_no_host_transfer(contract, tracer):
@@ -395,6 +440,7 @@ RULES: Dict[str, Callable] = {
     "wire-dtype": rule_wire_dtype,
     "sharded-collectives": rule_sharded_collectives,
     "sharded-opt-bytes": rule_sharded_opt_bytes,
+    "packed-no-overhead": rule_packed_no_overhead,
     "no-host-transfer": rule_no_host_transfer,
     "state-donated": rule_state_donated,
     "single-optimizer-apply": rule_single_optimizer_apply,

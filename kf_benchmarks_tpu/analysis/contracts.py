@@ -373,6 +373,14 @@ GOLDEN_CONFIGS: "OrderedDict[str, Dict[str, Any]]" = OrderedDict([
     ("lm_sharded", dict(model="transformer_lm", batch_size=8,
                         optimizer="momentum",
                         shard_optimizer_state=True)),
+    # PR 8 (round 13): the packed-sequence LM program. Segment-aware
+    # masks + the weighted chunked loss must keep the program class:
+    # still no (B, T, V) logits buffer, and the token-weighted metric
+    # combine PACKS the loss pmeans into one vector, so the packed
+    # step carries no more collectives than lm_base
+    # (audit.rule_packed_no_overhead).
+    ("lm_packed", dict(model="transformer_lm", batch_size=8,
+                       packed_sequences=True)),
     # PR 7: the elastic-rescale RESUME shape -- sharded_base after an
     # 8 -> 4 resize (the program benchmark.py rebuilds at the new mesh
     # and resumes into from the resliced checkpoint). Every sharded

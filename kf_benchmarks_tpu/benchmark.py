@@ -116,10 +116,14 @@ def compute_eval_step_set(params, global_batch_size: int,
 
 
 def feeder_prefetch(params) -> int:
-  """Host->device prefetch depth: the deeper of the dataset prefetch
-  buffer and --batch_group_size (the reference's input producers hand the
-  staging areas ``batch_group_size`` batches at a time,
-  ref: cnn_util.py:118-198 ImageProducer, benchmark_cnn.py:134-136)."""
+  """Host->device prefetch depth: --input_prefetch_depth when set,
+  else the deeper of the dataset prefetch buffer and
+  --batch_group_size (the reference's input producers hand the staging
+  areas ``batch_group_size`` batches at a time, ref: cnn_util.py:118-198
+  ImageProducer, benchmark_cnn.py:134-136)."""
+  explicit = getattr(params, "input_prefetch_depth", None)
+  if explicit:
+    return int(explicit)
   return max(params.datasets_prefetch_buffer_size or 1,
              params.batch_group_size or 1)
 
@@ -509,7 +513,30 @@ class BenchmarkCNN:
     data arrives as one (chunk, batch, ...) staged array; synthetic
     arrives with a leading axis of 1 (the scanned program reuses the
     resident batch, so no K-wide staging footprint exists at all).
+
+    --packed_sequences: the seeded host-side packer (data/packing.py)
+    is a REAL host pipeline even though no data_dir is set -- fresh
+    variable-length documents are drawn and bin-packed per batch, so
+    the stream runs through the DeviceFeeder like record data and the
+    feed instrumentation measures whether packing work hides behind
+    the step (feed_stall_fraction).
     """
+    from kf_benchmarks_tpu.data import device_feed
+    p = self.params
+    self._feeder = None
+    self._packed_stream = None
+    if getattr(p, "packed_sequences", False):
+      from kf_benchmarks_tpu.data import packing as packing_lib
+      # Seeded from the run's data rng (+ the elastic incarnation fold
+      # _open_input applied): same seed -> same document stream.
+      seed = int(np.asarray(
+          jax.random.randint(rng, (), 0, 2**31 - 1, jnp.int32)))
+      stream = packing_lib.PackedBatchStream(
+          seq_len=self.model.get_input_shapes(subset)[0][-1],
+          batch_size=self.batch_size, vocab=self._packed_vocab(),
+          seed=seed)
+      self._packed_stream = stream
+      return self._make_feeder(stream, chunk)
     if self.dataset.use_synthetic_gpu_inputs():
       batch = self._synthetic_global_batch(rng)
       if chunk > 1:
@@ -517,8 +544,6 @@ class BenchmarkCNN:
         batch = jax.tree.map(
             lambda x: jax.device_put(x[None], chunk_sharding), batch)
       return (lambda: batch), (lambda: None)
-    from kf_benchmarks_tpu.data import device_feed
-    p = self.params
     pre = self.dataset.get_input_preprocessor(p.input_preprocessor)
     if isinstance(pre, type):
       shape = self._model_image_shape()
@@ -549,13 +574,26 @@ class BenchmarkCNN:
     host_iter = pre.minibatches(self.dataset, subset)
     if self.compute_dtype != jnp.float32:
       host_iter = self._cast_images(host_iter)
+    return self._make_feeder(host_iter, chunk)
+
+  def _make_feeder(self, host_iter, chunk: int):
+    """The ONE DeviceFeeder recipe (sharding pick, prefetch depth,
+    stats bookkeeping) shared by the record-data and packed-stream
+    input paths, so a prefetch/sharding policy change cannot apply to
+    one and silently diverge the other."""
+    from kf_benchmarks_tpu.data import device_feed
     feeder = device_feed.DeviceFeeder(
         host_iter,
         mesh_lib.chunk_batch_sharding(self.mesh) if chunk > 1
         else mesh_lib.batch_sharding(self.mesh),
-        prefetch=max(feeder_prefetch(p), chunk), chunk=chunk)
+        prefetch=max(feeder_prefetch(self.params), chunk), chunk=chunk)
+    self._feeder = feeder
     it = iter(feeder)
     return (lambda: next(it)), feeder.stop
+
+  def _packed_vocab(self) -> int:
+    from kf_benchmarks_tpu.models import transformer_lm as lm
+    return lm.VOCAB
 
   def _cast_images(self, host_iter):
     """Cast float32 host batches to the compute dtype before the H2D copy
@@ -796,7 +834,12 @@ class BenchmarkCNN:
     tele = getattr(self, "_telemetry", None)
     K = self.steps_per_dispatch
     chunked = K > 1 and train_chunk is not None
-    synthetic = self.dataset.use_synthetic_gpu_inputs()
+    # "synthetic" here means the RESIDENT single-batch feed (reused
+    # every step, staged once); a --packed_sequences run has no
+    # data_dir but streams fresh host-packed batches through the
+    # DeviceFeeder, so it takes the real-data cursor/chunk paths.
+    synthetic = (self.dataset.use_synthetic_gpu_inputs() and
+                 not getattr(p, "packed_sequences", False))
     images, labels = next_batch()
 
     def _step_slice(ims, lbs, j: int = 0):
@@ -1488,6 +1531,18 @@ class BenchmarkCNN:
       for line in observability.chunk_timing_rows(
           K, chunk_times, self.batch_size * max(self.num_workers, 1)):
         log_fn(line)
+    # Input-pipeline line (next to the timing rows; the roofline table
+    # covers the device side, this covers the host edge): packing
+    # efficiency of the document packer plus the measured feed-stall
+    # fraction proving (or disproving) that the DeviceFeeder prefetch
+    # overlapped host work with the step (observability.py).
+    feeder = getattr(self, "_feeder", None)
+    feed_stats = feeder.stats() if feeder is not None else None
+    packing_stats = (self._packed_stream.stats()
+                     if getattr(self, "_packed_stream", None) is not None
+                     else None)
+    if feed_stats is not None and feed_stats["fetches"]:
+      log_fn(observability.packing_feed_line(feed_stats, packing_stats))
     if bench_logger is not None:
       # Final throughput metrics (ref: _log_benchmark_run
       # average_examples_per_sec emission).
@@ -1576,6 +1631,14 @@ class BenchmarkCNN:
             str(int(s)) for s in self.mesh.devices.shape),
         "opt_state_bytes_per_device": opt_state_bytes_per_device(
             state.opt_state),
+        # Input-pipeline health: fraction of the consume window the
+        # loop spent BLOCKED on the feed (None for the resident
+        # synthetic batch, which has no feeder) and the packer's
+        # measured efficiency (None unless --packed_sequences).
+        "feed_stall_fraction": (feed_stats["feed_stall_fraction"]
+                                if feed_stats else None),
+        "packing_efficiency": (packing_stats["packing_efficiency"]
+                               if packing_stats else None),
         "state": state,
     }
 

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterator, Optional, Tuple
 
 import jax
@@ -32,17 +33,36 @@ from kf_benchmarks_tpu.parallel import mesh as mesh_lib
 
 
 class DeviceFeeder:
-  """Prefetching device-transfer iterator (depth-``prefetch`` pipeline)."""
+  """Prefetching device-transfer iterator (depth-``prefetch`` pipeline).
+
+  Instrumented: every ``__next__`` records the consumer's blocked-wait
+  time and the queue depth it found, so ``stats()`` can answer the
+  question the reference never measured about its StagingArea chain --
+  does the prefetch actually OVERLAP host work with device compute?
+  ``feed_stall_fraction`` (consumer wait / wall time across the consume
+  window) ~0 means the feed hides behind the step; ~1 means the loop is
+  input-bound and ``--input_prefetch_depth`` (or more host threads) is
+  the lever. Rides the benchmark stats and the bench JSON line.
+  """
 
   def __init__(self, host_iterator: Iterator, sharding,
                prefetch: int = 2, chunk: int = 1):
     self._host_iterator = host_iterator
     self._sharding = sharding
     self._chunk = max(1, chunk)
-    depth = -(-max(1, prefetch) // self._chunk)  # batches -> whole chunks
+    self.prefetch_batches = max(1, prefetch)
+    depth = -(-self.prefetch_batches // self._chunk)  # batches -> chunks
     self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
     self._stop = threading.Event()
     self._error: Optional[BaseException] = None
+    # Consumer-side instrumentation (all under the consumer thread; no
+    # locking needed -- __next__ is single-consumer by contract).
+    self._wait_s = 0.0
+    self._fetches = 0
+    self._depth_sum = 0
+    self._depth_max = 0
+    self._window_start: Optional[float] = None
+    self._window_end: Optional[float] = None
     self._thread = threading.Thread(target=self._worker, daemon=True,
                                     name="device-feeder")
     self._thread.start()
@@ -91,6 +111,10 @@ class DeviceFeeder:
     return self
 
   def __next__(self):
+    t0 = time.monotonic()
+    if self._window_start is None:
+      self._window_start = t0
+    depth = self._queue.qsize()
     # Poll with a timeout so a worker error is surfaced even when the
     # queue is full at error time and the sentinel could not be enqueued.
     while True:
@@ -103,10 +127,42 @@ class DeviceFeeder:
         if not self._thread.is_alive():
           raise StopIteration
     if item is None:
+      # End-of-stream sentinel: not a delivered batch -- counting its
+      # (terminal-drain) wait would read a healthy finite stream as
+      # input-bound.
       if self._error is not None:
         raise self._error
       raise StopIteration
+    now = time.monotonic()
+    self._wait_s += now - t0
+    self._window_end = now
+    self._fetches += 1
+    # Queue depth in BATCH units (the queue itself holds chunks when
+    # chunk > 1), so the number reads against prefetch_batches.
+    self._depth_sum += depth * self._chunk
+    self._depth_max = max(self._depth_max, depth * self._chunk)
     return item
+
+  def stats(self) -> dict:
+    """Consumer-side feed stats: total blocked wait, the wall window
+    spanning the fetches, the stall fraction (wait / window -- the
+    fraction of loop wall the feed failed to hide), and queue depth at
+    fetch time (mean/max; depth ~prefetch means the worker keeps up).
+    The first fetch's wait covers pipeline warm-fill and is counted --
+    report stats over a run long enough to amortize it."""
+    window = ((self._window_end - self._window_start)
+              if self._fetches and self._window_end is not None else 0.0)
+    return {
+        "fetches": self._fetches,
+        "consumer_wait_s": self._wait_s,
+        "window_s": window,
+        "feed_stall_fraction": (self._wait_s / window if window > 0
+                                else None),
+        "queue_depth_mean": (self._depth_sum / self._fetches
+                             if self._fetches else None),
+        "queue_depth_max": self._depth_max,
+        "prefetch_batches": self.prefetch_batches,
+    }
 
   def stop(self) -> None:
     self._stop.set()

@@ -1,0 +1,267 @@
+"""Variable-length sequence packing for the transformer_lm input pipeline.
+
+BEYOND-REFERENCE: the reference's input story is fixed-shape images
+through the StagingArea / MultiDeviceIterator prefetch chain (ref:
+benchmark_cnn.py:2572-2600, preprocessing.py:368-399) and its only
+variable-length machinery is DeepSpeech2 utterance padding (ref:
+preprocessing.py:977-1112) -- every slot is either full or padded.
+LM pretraining traffic is variable-length documents at a fixed context
+(2048 here), where padding waste is a direct multiplier on useful
+tokens/s; the standard input form is BIN-PACKED documents with segment
+ids (T5 / GPT-NeoX style packing), which this module provides as the
+host-side half of ``--packed_sequences``:
+
+* ``PackedBatchStream`` -- an infinite, seeded host iterator yielding
+  ``(images, labels)`` batches where ``images`` is the ``(B, 3, T)``
+  int32 stack of ``[tokens, segment_ids, positions]`` and ``labels``
+  the in-document next-token ids. Document lengths draw from a clipped
+  lognormal (the realistic heavy-tailed doc-length shape); packing is
+  deterministic FIRST-FIT over a bounded lookahead window, so the same
+  seed always produces the same batches (the A/B and resume contract).
+* Conventions: ``segment_ids`` are 1-based per row in placement order
+  with 0 = padding; documents are never split across rows or batches;
+  ``positions`` restart at 0 at each document start (the position
+  embedding is per-document, so a packed document computes exactly what
+  it would alone); padding sits at the row tail only.
+* ``token_weights_from_segments`` -- the ONE derivation of the
+  per-token loss weights (1.0 where a token has an in-document
+  next-token label, 0.0 at padding and each document's final slot),
+  shared by the model's loss/metrics and the train step's
+  token-weighted metric combine so the two cannot drift.
+
+The device-side halves are the segment-aware masks in
+``parallel/sequence.py`` and the weighted chunked loss in
+``ops/fused_loss.py``.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+# First-fit lookahead bound: how many pending documents the packer may
+# scan past the head-of-line to fill a row. Bounded so host latency per
+# batch stays O(B * lookahead) and the stream order stays near-FIFO.
+DEFAULT_LOOKAHEAD = 64
+
+# Clipped-lognormal document-length distribution defaults: median well
+# under the context so rows hold several documents (the regime where
+# packing pays), sigma wide enough for a realistic heavy tail.
+DEFAULT_MEAN_FRACTION = 0.4
+DEFAULT_SIGMA = 0.8
+
+
+def token_weights_from_segments(segment_ids):
+  """Per-token loss weights from packed segment ids: 1.0 where the slot
+  holds a real token whose NEXT slot continues the same document (i.e.
+  the position has an in-document next-token label), else 0.0 --
+  padding (id 0), each document's final token, and the row's last slot
+  all weigh 0. Works on numpy or jax arrays of shape (..., T)."""
+  if isinstance(segment_ids, np.ndarray):
+    xp = np
+  else:
+    import jax.numpy as xp  # jnp inside jit; numpy for host-side tests
+  seg = segment_ids
+  nxt = xp.concatenate(
+      [seg[..., 1:], xp.zeros_like(seg[..., :1])], axis=-1)
+  return ((seg != 0) & (nxt == seg)).astype(xp.float32)
+
+
+def packing_efficiency(segment_ids) -> float:
+  """Fraction of slots holding real tokens (padding excluded)."""
+  seg = np.asarray(segment_ids)
+  return float(np.count_nonzero(seg)) / float(max(seg.size, 1))
+
+
+def sample_document_lengths(rng: np.random.Generator, n: int,
+                            seq_len: int,
+                            mean_fraction: float = DEFAULT_MEAN_FRACTION,
+                            sigma: float = DEFAULT_SIGMA) -> np.ndarray:
+  """``n`` document lengths from a lognormal with median
+  ``mean_fraction * seq_len``, clipped to [1, seq_len] -- clipping (not
+  rejection) keeps the draw count deterministic, and the packer's
+  no-split contract needs every document to fit one row."""
+  mu = np.log(max(mean_fraction * seq_len, 1.0))
+  lengths = np.exp(rng.normal(mu, sigma, size=n))
+  return np.clip(lengths.astype(np.int64), 1, seq_len)
+
+
+class PackedBatch(collections.abc.Sequence):
+  """One packed batch: ``images`` (B, 3, T) int32 [tokens, segment_ids,
+  positions] and ``labels`` (B, T) int32 in-document next-token ids
+  (0 where no in-document label exists; those slots weigh 0). Sequence
+  protocol yields (images, labels) so callers can tuple-unpack."""
+
+  def __init__(self, images: np.ndarray, labels: np.ndarray):
+    self.images = images
+    self.labels = labels
+
+  @property
+  def tokens(self):
+    return self.images[:, 0]
+
+  @property
+  def segment_ids(self):
+    return self.images[:, 1]
+
+  @property
+  def positions(self):
+    return self.images[:, 2]
+
+  def __len__(self):
+    return 2
+
+  def __getitem__(self, i):
+    return (self.images, self.labels)[i]
+
+
+def _materialize(rows: List[List[np.ndarray]], batch_size: int,
+                 seq_len: int) -> PackedBatch:
+  images = np.zeros((batch_size, 3, seq_len), np.int32)
+  labels = np.zeros((batch_size, seq_len), np.int32)
+  for r, docs in enumerate(rows):
+    off = 0
+    for s, doc in enumerate(docs, start=1):
+      ln = len(doc)
+      images[r, 0, off:off + ln] = doc
+      images[r, 1, off:off + ln] = s
+      images[r, 2, off:off + ln] = np.arange(ln)
+      labels[r, off:off + ln - 1] = doc[1:]
+      off += ln
+  return PackedBatch(images, labels)
+
+
+def pack_documents(docs: Iterable[np.ndarray], seq_len: int,
+                   batch_size: int,
+                   lookahead: int = DEFAULT_LOOKAHEAD
+                   ) -> Iterator[PackedBatch]:
+  """Deterministic first-fit packing of a document stream into
+  ``(batch_size, seq_len)`` rows.
+
+  For each batch: scan the bounded lookahead window in stream order and
+  place the first document that fits into the first row with room
+  (opening rows up to ``batch_size``); repeat until nothing in the
+  window fits, then emit. Documents are never split; a document longer
+  than ``seq_len`` raises. The final batch may be partial (trailing
+  all-padding rows) but always carries the full static shape.
+  """
+  if lookahead < 1:
+    raise ValueError(f"lookahead must be >= 1, got {lookahead}")
+  it = iter(docs)
+  window: collections.deque = collections.deque()
+  exhausted = False
+
+  def refill():
+    nonlocal exhausted
+    while not exhausted and len(window) < lookahead:
+      try:
+        doc = np.asarray(next(it))
+      except StopIteration:
+        exhausted = True
+        return
+      if doc.ndim != 1 or doc.size < 1:
+        raise ValueError("documents must be non-empty 1-D token arrays")
+      if doc.size > seq_len:
+        raise ValueError(
+            f"document of {doc.size} tokens exceeds the {seq_len}-token "
+            "context; the packer never splits documents")
+      window.append(doc)
+
+  refill()
+  while window:
+    rows: List[List[np.ndarray]] = []
+    remaining: List[int] = []
+    while True:
+      refill()
+      placed = False
+      for w_idx, doc in enumerate(window):
+        row = next((r for r in range(len(rows))
+                    if remaining[r] >= doc.size), None)
+        if row is None and len(rows) < batch_size:
+          rows.append([])
+          remaining.append(seq_len)
+          row = len(rows) - 1
+        if row is not None:
+          rows[row].append(doc)
+          remaining[row] -= doc.size
+          del window[w_idx]
+          placed = True
+          break
+      if not placed:
+        break
+    yield _materialize(rows, batch_size, seq_len)
+
+
+class PackedBatchStream:
+  """Infinite seeded packed-batch iterator (the host half of
+  ``--packed_sequences``): documents of random tokens with lognormal
+  lengths, first-fit packed, yielding ``(images, labels)`` tuples the
+  ``DeviceFeeder`` stages like any host pipeline.
+
+  ``one_per_row=True`` is the A/B baseline: each row holds ONE document
+  padded to the context (the naive variable-length feed), so the
+  packed-vs-padded useful-tokens/s ratio isolates exactly what packing
+  buys (experiments/packing_probe.py).
+
+  ``stats()`` reports cumulative documents/real-token counts and the
+  measured packing efficiency the observability feed line prints.
+  """
+
+  def __init__(self, seq_len: int, batch_size: int, vocab: int,
+               seed: int = 0, lookahead: int = DEFAULT_LOOKAHEAD,
+               mean_fraction: float = DEFAULT_MEAN_FRACTION,
+               sigma: float = DEFAULT_SIGMA,
+               one_per_row: bool = False):
+    self.seq_len = seq_len
+    self.batch_size = batch_size
+    self.vocab = vocab
+    self._rng = np.random.default_rng(seed)
+    self._mean_fraction = mean_fraction
+    self._sigma = sigma
+    self._documents = 0
+    self._real_tokens = 0
+    self._slots = 0
+    if one_per_row:
+      self._batches = map(
+          lambda docs: _materialize([[d] for d in docs], batch_size,
+                                    seq_len),
+          self._doc_groups(batch_size))
+    else:
+      self._batches = pack_documents(self._docs(), seq_len, batch_size,
+                                     lookahead=lookahead)
+
+  def _docs(self) -> Iterator[np.ndarray]:
+    while True:
+      ln = int(sample_document_lengths(
+          self._rng, 1, self.seq_len, self._mean_fraction,
+          self._sigma)[0])
+      yield self._rng.integers(0, self.vocab, size=ln, dtype=np.int32)
+
+  def _doc_groups(self, n: int) -> Iterator[List[np.ndarray]]:
+    docs = self._docs()
+    while True:
+      yield [next(docs) for _ in range(n)]
+
+  def __iter__(self):
+    return self
+
+  def __next__(self):
+    batch = next(self._batches)
+    self._real_tokens += int(np.count_nonzero(batch.segment_ids))
+    self._slots += batch.segment_ids.size
+    # Documents counted at EMIT time (segment ids are dense 1..S per
+    # row, so per-row max = the row's document count); counting at
+    # draw time would overstate by the packer's buffered lookahead.
+    self._documents += int(batch.segment_ids.max(axis=1).sum())
+    return batch.images, batch.labels
+
+  def stats(self) -> dict:
+    return {
+        "documents": self._documents,
+        "real_tokens": self._real_tokens,
+        "token_slots": self._slots,
+        "packing_efficiency": (self._real_tokens / self._slots
+                               if self._slots else None),
+    }

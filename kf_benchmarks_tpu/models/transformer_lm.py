@@ -76,7 +76,11 @@ class _Block(nn.Module):
   param_dtype: Any
 
   @nn.compact
-  def __call__(self, x, _):
+  def __call__(self, carry, _):
+    # Carry = (hidden states, packed segment ids or None): the segment
+    # ids ride the scan carry unchanged so every block's attention sees
+    # them without a second scan input (--packed_sequences).
+    x, seg = carry
     b, t, _d = x.shape
     head_dim = self.d_model // self.n_heads
     dense = lambda feats, name, bias=True: nn.Dense(
@@ -94,14 +98,15 @@ class _Block(nn.Module):
       # Matched tilings: the A/B against the tiled path must not
       # confound kernel choice with tile size, so the kernel gets
       # the same block as the scan (long_context_probe.py ditto).
+      # Packed runs ride the kernel's native SegmentIds support.
       att = sequence_lib.pallas_flash_attention(
           qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], causal=True,
-          block=blk)
+          block=blk, segment_ids=seg)
     elif self.attn_impl == "tiled":
       att = sequence_lib.blockwise_attention(
           qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
           block_size=blk, causal=True,
-          q_block_size=min(self.attn_q_block, t))
+          q_block_size=min(self.attn_q_block, t), segment_ids=seg)
     else:
       raise ValueError(
           f"attn_impl must be 'tiled' or 'flash', got "
@@ -111,7 +116,7 @@ class _Block(nn.Module):
     h = ln("ln2")(x).astype(self.dtype)
     h = nn.gelu(dense(self.d_ff, "mlp_up")(h))
     x = x + dense(self.d_model, "mlp_down")(h)
-    return x, None
+    return (x, seg), None
 
 
 class _TransformerLMModule(nn.Module):
@@ -153,6 +158,14 @@ class _TransformerLMModule(nn.Module):
   @nn.compact
   def __call__(self, tokens):
     tokens = tokens.astype(jnp.int32)
+    seg = positions = None
+    if tokens.ndim == 3:
+      # Packed input (--packed_sequences): the (B, 3, T) int32 stack
+      # [tokens, segment_ids, positions] from data/packing.py. Shape
+      # is the mode switch, so the module needs no config flag and
+      # unpacked callers keep the exact legacy program.
+      tokens, seg, positions = (tokens[:, 0], tokens[:, 1],
+                                tokens[:, 2])
     b, t = tokens.shape
     block_kwargs = dict(
         d_model=self.d_model, n_heads=self.n_heads, d_ff=self.d_ff,
@@ -166,7 +179,12 @@ class _TransformerLMModule(nn.Module):
         "pos_embedding",
         nn.initializers.normal(0.02, self.param_dtype),
         (self.max_len, self.d_model))
-    x = x + pos[:t].astype(self.dtype)
+    if positions is None:
+      x = x + pos[:t].astype(self.dtype)
+    else:
+      # Per-document positions (restart at 0 per segment): a packed
+      # document reads the same position rows it would alone.
+      x = x + jnp.take(pos, positions, axis=0).astype(self.dtype)
 
     if self.scan_layers:
       # One block body in the compiled program regardless of depth;
@@ -195,10 +213,11 @@ class _TransformerLMModule(nn.Module):
           variable_axes={"params": 0},
           split_rngs={"params": True},
           length=self.n_layers)(name="blocks", **block_kwargs)
-      x, _ = blocks(x, None)
+      (x, _), _ = blocks((x, seg), None)
     else:
       for i in range(self.n_layers):
-        x, _ = _Block(name=f"block_{i}", **block_kwargs)(x, None)
+        (x, _), _ = _Block(name=f"block_{i}", **block_kwargs)((x, seg),
+                                                              None)
 
     x = nn.LayerNorm(name="ln_f", dtype=jnp.float32,
                      param_dtype=self.param_dtype)(x)
@@ -207,13 +226,20 @@ class _TransformerLMModule(nn.Module):
     # the loss upcasts per sequence chunk instead.
     w_head = self.param("lm_head", nn.initializers.lecun_normal(),
                         (self.d_model, self.vocab), self.param_dtype)
+    aux = None
+    if seg is not None:
+      # Packed runs hand the per-token loss weights to the loss and
+      # accuracy functions through the aux slot (the ONE derivation,
+      # data/packing.py): 0 at padding and document-final slots.
+      from kf_benchmarks_tpu.data import packing as packing_lib
+      aux = packing_lib.token_weights_from_segments(seg)
     if self.fused_head:
       # No logits here at ALL: the head matmul itself is deferred into
       # the chunked loss/accuracy reductions (ops/fused_loss.py).
       return fused_loss_lib.FusedLMHead(
-          hidden=x.astype(self.dtype), kernel=w_head), None
+          hidden=x.astype(self.dtype), kernel=w_head), aux
     logits = x.astype(self.dtype) @ w_head.astype(self.dtype)
-    return logits, None
+    return logits, aux
 
 
 class TransformerLMModel(model_lib.Model):
@@ -223,6 +249,19 @@ class TransformerLMModel(model_lib.Model):
   def __init__(self, params=None):
     super().__init__("transformer_lm", batch_size=8, learning_rate=0.05,
                      fp16_loss_scale=128, params=params)
+    # --packed_sequences: inputs become the (B, 3, T) packed stack and
+    # losses/metrics weight by real-token count (data/packing.py).
+    self.packed = bool(getattr(params, "packed_sequences", False)
+                       ) if params is not None else False
+    if self.packed:
+      from kf_benchmarks_tpu.data import packing as packing_lib
+      # The train step's token-weighted metric combine reads each
+      # replica's real-label weights from the packed input stack
+      # (images[:, 1] = segment ids) -- the same derivation the
+      # module's aux weights use, so loss and metrics cannot drift.
+      self.token_weight_fn = (
+          lambda images: packing_lib.token_weights_from_segments(
+              images[:, 1]))
 
   def make_module(self, nclass, phase_train, data_format="NHWC",
                   dtype=jnp.float32, param_dtype=jnp.float32):
@@ -274,6 +313,9 @@ class TransformerLMModel(model_lib.Model):
 
   def get_input_shapes(self, subset):
     n = self.get_batch_size()
+    if self.packed:
+      # [tokens, segment_ids, positions] stacked (data/packing.py).
+      return [[n, 3, SEQ_LEN], [n, SEQ_LEN]]
     return [[n, SEQ_LEN], [n, SEQ_LEN]]
 
   def get_input_data_types(self, subset):
@@ -281,6 +323,16 @@ class TransformerLMModel(model_lib.Model):
 
   def get_synthetic_inputs(self, rng, nclass):
     n = self.get_batch_size()
+    if self.packed:
+      # One deterministic packed batch (direct callers / AOT; the
+      # benchmark streams fresh batches through the DeviceFeeder
+      # instead, benchmark.py _input_iterator).
+      from kf_benchmarks_tpu.data import packing as packing_lib
+      stream = packing_lib.PackedBatchStream(
+          SEQ_LEN, n, VOCAB, seed=int(jax.random.randint(
+              rng, (), 0, 2**31 - 1)))
+      images, labels = next(stream)
+      return jnp.asarray(images), jnp.asarray(labels)
     tokens = jax.random.randint(rng, (n, SEQ_LEN), 0, VOCAB, jnp.int32)
     # Next-token labels: the shifted stream, so the synthetic objective
     # is the real LM objective (learnable, not pure noise).
@@ -294,13 +346,16 @@ class TransformerLMModel(model_lib.Model):
   LOSS_CHUNK = 256
 
   def loss_function(self, build_network_result, labels):
-    out, _ = build_network_result.logits
+    # aux carries the packed per-token loss weights (the module derives
+    # them from the segment ids); None on unpacked runs.
+    out, weights = build_network_result.logits
     labels = labels.astype(jnp.int32)
     if isinstance(out, fused_loss_lib.FusedLMHead):
       # Fused head: loss straight from (hidden, kernel); no logits
       # tensor exists anywhere in the step (ops/fused_loss.py).
       return fused_loss_lib.fused_softmax_xent(
-          out.hidden, out.kernel, labels, chunk_size=self.LOSS_CHUNK)
+          out.hidden, out.kernel, labels, chunk_size=self.LOSS_CHUNK,
+          weights=weights)
     # Dense-head fallback: logits are materialized; chunk the softmax
     # reduction only (the round-6 bounded-memory path).
     logits = out
@@ -308,34 +363,46 @@ class TransformerLMModel(model_lib.Model):
     chunk = fused_loss_lib.chunk_of(t, self.LOSS_CHUNK)
     lc = logits.reshape(b, t // chunk, chunk, v).swapaxes(0, 1)
     yc = labels.reshape(b, t // chunk, chunk).swapaxes(0, 1)
+    wc = None if weights is None else weights.astype(
+        jnp.float32).reshape(b, t // chunk, chunk).swapaxes(0, 1)
 
     @jax.checkpoint
     def body(carry, xs):
-      lg, yy = xs
+      lg, yy, ww = xs
       logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
       ll = jnp.take_along_axis(logp, yy[..., None], axis=-1)
+      if ww is not None:
+        ll = ll * ww[..., None]
       return carry + jnp.sum(ll), None
 
     (zero,) = sequence_lib.vary_like(logits,
                                      (jnp.zeros((), jnp.float32),))
-    total, _ = jax.lax.scan(body, zero, (lc, yc))
-    return -total / (b * t)
+    total, _ = jax.lax.scan(body, zero, (lc, yc, wc))
+    if weights is None:
+      return -total / (b * t)
+    return -total / jnp.maximum(
+        jnp.sum(weights.astype(jnp.float32)), 1.0)
 
   def accuracy_function(self, build_network_result, labels):
-    out, _ = build_network_result.logits
+    out, weights = build_network_result.logits
     labels = labels.astype(jnp.int32)
     if isinstance(out, fused_loss_lib.FusedLMHead):
       return fused_loss_lib.fused_top_k_accuracy(
-          out.hidden, out.kernel, labels, chunk_size=self.LOSS_CHUNK)
+          out.hidden, out.kernel, labels, chunk_size=self.LOSS_CHUNK,
+          weights=weights)
     logits = out
     # argmax/top_k reduce away the vocab axis chunk-free (no f32
     # upcast of the full logits tensor is ever materialised).
-    top1 = jnp.mean((jnp.argmax(logits, -1) == labels).astype(
-        jnp.float32))
-    top5 = jnp.mean(jnp.any(
-        jax.lax.top_k(logits, 5)[1] == labels[..., None],
-        axis=-1).astype(jnp.float32))
-    return {"top_1_accuracy": top1, "top_5_accuracy": top5}
+    hit1 = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+    hit5 = jnp.any(jax.lax.top_k(logits, 5)[1] == labels[..., None],
+                   axis=-1).astype(jnp.float32)
+    if weights is None:
+      return {"top_1_accuracy": jnp.mean(hit1),
+              "top_5_accuracy": jnp.mean(hit5)}
+    w = weights.astype(jnp.float32)
+    denom = jnp.maximum(jnp.sum(w), 1.0)
+    return {"top_1_accuracy": jnp.sum(hit1 * w) / denom,
+            "top_5_accuracy": jnp.sum(hit5 * w) / denom}
 
 
 def create_transformer_lm_model(params=None):

@@ -448,6 +448,38 @@ def dump_per_op_profile(compiled, path: str, top_n: int = 20,
   return table
 
 
+def packing_feed_line(feed_stats: Dict[str, Any],
+                      packing_stats: Optional[Dict[str, Any]] = None
+                      ) -> str:
+  """One operator-facing input-pipeline line (printed next to the
+  timing rows; the device-side roofline table has no host-edge row):
+  the DeviceFeeder's measured feed-stall fraction -- the share of the
+  consume window the step loop spent BLOCKED on the feed, ~0 when the
+  prefetch overlaps host work with device compute -- plus, for
+  --packed_sequences runs, the packer's measured efficiency (real
+  tokens / slots, the useful-tokens/s multiplier packing buys over the
+  one-document-per-row padded baseline)."""
+  parts = []
+  if packing_stats and packing_stats.get("packing_efficiency") is not None:
+    parts.append(
+        "packing efficiency %.1f%% (%d real tokens / %d slots, %d docs)"
+        % (100.0 * packing_stats["packing_efficiency"],
+           packing_stats["real_tokens"], packing_stats["token_slots"],
+           packing_stats["documents"]))
+  stall = feed_stats.get("feed_stall_fraction")
+  depth_mean = feed_stats.get("queue_depth_mean")
+  parts.append(
+      "feed stall %s of wall (%.1f ms wait / %d fetches, queue depth "
+      "%.1f mean / %d max, prefetch %d)"
+      % ("%.1f%%" % (100.0 * stall) if stall is not None else "n/a",
+         1e3 * feed_stats.get("consumer_wait_s", 0.0),
+         feed_stats.get("fetches", 0),
+         depth_mean if depth_mean is not None else 0.0,
+         feed_stats.get("queue_depth_max", 0),
+         feed_stats.get("prefetch_batches", 0)))
+  return "input pipeline: " + "; ".join(parts)
+
+
 def chunk_timing_rows(steps_per_dispatch: int, chunk_intervals,
                       global_batch: int, max_rows: int = 8):
   """Per-chunk timing rows for the chunked dispatch mode

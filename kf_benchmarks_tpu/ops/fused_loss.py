@@ -29,6 +29,12 @@ materializes the full logits tensor and reduces in the same chunk
 order (``monolithic_softmax_xent`` below) -- chunking a matmul along
 rows and log-softmax along its batch axes is exact, so the only
 freedom is summation order, which both sides fix identically.
+
+Packed sequences (--packed_sequences): both reductions take optional
+per-token ``weights`` (data/packing.py token_weights_from_segments --
+0 at padding and document-final slots) and normalize by the REAL-token
+count; ``weights=None`` keeps the exact unweighted program, so every
+pre-packing pin is untouched.
 """
 
 from __future__ import annotations
@@ -67,66 +73,90 @@ def _chunked(x, chunk: int):
   return x.reshape((b, t // chunk, chunk) + x.shape[2:]).swapaxes(0, 1)
 
 
-def fused_softmax_xent(hidden, kernel, labels, chunk_size: int = 256):
+def fused_softmax_xent(hidden, kernel, labels, chunk_size: int = 256,
+                       weights=None):
   """Mean next-token NLL from (hidden, kernel) with O(B*chunk*V) temps.
 
   ``hidden`` (B, T, D) stays in the model compute dtype through the
   per-chunk head matmul (bf16 on TPU under --use_fp16: the head computes
   in the model dtype, exactly like the Dense head it replaces); the
   softmax upcasts the CHUNK to f32. Returns a f32 scalar.
+
+  ``weights`` (B, T) engages packed-sequence masking (data/packing.py
+  token_weights_from_segments): each slot's log-likelihood is scaled by
+  its weight inside the scan and the mean normalizes by the REAL-token
+  count ``sum(weights)`` instead of B*T -- padding and document-final
+  slots (weight 0) contribute exact zeros, so a packed document's
+  contribution is bit-identical to the same document alone. ``None``
+  keeps the exact unweighted program (the pinned fused-head oracle).
   """
   labels = labels.astype(jnp.int32)
   b, t, _ = hidden.shape
   chunk = chunk_of(t, chunk_size)
   hc = _chunked(hidden, chunk)
   yc = _chunked(labels, chunk)
+  wc = None if weights is None else _chunked(
+      weights.astype(jnp.float32), chunk)
 
   @jax.checkpoint
   def body(carry, xs):
-    hh, yy = xs
+    hh, yy, ww = xs
     # Per-chunk head matmul: rows of the monolithic logits, bit-exact
     # (matmul output rows depend only on their own input rows).
     lg = hh @ kernel.astype(hh.dtype)
     logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, yy[..., None], axis=-1)
+    if ww is not None:
+      ll = ll * ww[..., None]
     return carry + jnp.sum(ll), None
 
   # Inside a shard_map body the hidden states are device-varying, so the
   # carry must be pcast to match (no-op on pre-vma jax; sequence.py).
   (zero,) = sequence_lib.vary_like(hidden,
                                    (jnp.zeros((), jnp.float32),))
-  total, _ = jax.lax.scan(body, zero, (hc, yc))
-  return -total / (b * t)
+  total, _ = jax.lax.scan(body, zero, (hc, yc, wc))
+  if weights is None:
+    return -total / (b * t)
+  return -total / jnp.maximum(jnp.sum(weights.astype(jnp.float32)), 1.0)
 
 
-def fused_top_k_accuracy(hidden, kernel, labels, chunk_size: int = 256):
+def fused_top_k_accuracy(hidden, kernel, labels, chunk_size: int = 256,
+                         weights=None):
   """top-1/top-5 fractions from (hidden, kernel), chunk at a time.
 
   argmax/top_k reduce away the vocab axis inside the scan, so the live
   set per iteration is one (B, chunk, V) logits slice -- no f32 upcast
   is needed for an order statistic, matching the Dense-head accuracy
-  path's dtype behavior.
+  path's dtype behavior. ``weights`` (B, T): packed-sequence masking --
+  hits are weighted and the fractions normalize by the real-token count
+  (see ``fused_softmax_xent``).
   """
   labels = labels.astype(jnp.int32)
   b, t, _ = hidden.shape
   chunk = chunk_of(t, chunk_size)
   hc = _chunked(hidden, chunk)
   yc = _chunked(labels, chunk)
+  wc = None if weights is None else _chunked(
+      weights.astype(jnp.float32), chunk)
 
   def body(carry, xs):
-    hh, yy = xs
+    hh, yy, ww = xs
     lg = hh @ kernel.astype(hh.dtype)
-    top1 = jnp.sum((jnp.argmax(lg, -1) == yy).astype(jnp.float32))
-    top5 = jnp.sum(jnp.any(
-        jax.lax.top_k(lg, 5)[1] == yy[..., None], axis=-1)
-        .astype(jnp.float32))
+    hit1 = (jnp.argmax(lg, -1) == yy).astype(jnp.float32)
+    hit5 = jnp.any(jax.lax.top_k(lg, 5)[1] == yy[..., None],
+                   axis=-1).astype(jnp.float32)
+    if ww is not None:
+      hit1 = hit1 * ww
+      hit5 = hit5 * ww
     c1, c5 = carry
-    return (c1 + top1, c5 + top5), None
+    return (c1 + jnp.sum(hit1), c5 + jnp.sum(hit5)), None
 
   zeros = sequence_lib.vary_like(
       hidden, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)))
-  (n1, n5), _ = jax.lax.scan(body, tuple(zeros), (hc, yc))
-  return {"top_1_accuracy": n1 / (b * t), "top_5_accuracy": n5 / (b * t)}
+  (n1, n5), _ = jax.lax.scan(body, tuple(zeros), (hc, yc, wc))
+  denom = (jnp.float32(b * t) if weights is None else
+           jnp.maximum(jnp.sum(weights.astype(jnp.float32)), 1.0))
+  return {"top_1_accuracy": n1 / denom, "top_5_accuracy": n5 / denom}
 
 
 def monolithic_softmax_xent(hidden, kernel, labels,

@@ -52,20 +52,31 @@ _NEG = -1e30
 
 
 def full_attention(q, k, v, causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None, segment_ids=None):
   """Plain O(L^2) multi-head attention; (batch, seq, heads, head_dim).
 
   The single-device reference the parallel schedules are tested
   against, and the local inner step of ``ulysses_attention``.
+
+  ``segment_ids`` (B, L) int: packed-sequence masking -- a query
+  attends only keys of ITS segment (equality, the Pallas SegmentIds
+  convention: padding id 0 attends padding, so no row is ever fully
+  masked and the causal diagonal keeps every row finite).
   """
   d = q.shape[-1]
   scale = (1.0 / math.sqrt(d)) if scale is None else scale
   s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                  k.astype(jnp.float32)) * scale
+  mask = None
   if causal:
     lq, lk = q.shape[1], k.shape[1]
-    mask = jnp.arange(lq)[:, None] >= jnp.arange(lk)[None, :]
-    s = jnp.where(mask[None, None], s, _NEG)
+    mask = (jnp.arange(lq)[:, None] >= jnp.arange(lk)[None, :])[None, None]
+  if segment_ids is not None:
+    seg_mask = (segment_ids[:, :, None] ==
+                segment_ids[:, None, :])[:, None]
+    mask = seg_mask if mask is None else (mask & seg_mask)
+  if mask is not None:
+    s = jnp.where(mask, s, _NEG)
   p = jax.nn.softmax(s, axis=-1)
   out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
   return out.astype(q.dtype)
@@ -135,7 +146,7 @@ def _block_update(q, k, v, m, l, o, scale, mask):
 
 
 def _block_update_remat(q, k, v, m, l, o, scale, offsets=None,
-                        prevent_cse=True):
+                        prevent_cse=True, seg_q=None, seg_k=None):
   """``_block_update`` with recompute-in-backward (flash-style remat).
 
   Without this, autodiff saves the (.., Tq, Tk) score/probability
@@ -150,11 +161,15 @@ def _block_update_remat(q, k, v, m, l, o, scale, offsets=None,
   INSIDE the checkpointed region from them, so the per-step residual
   is two scalars -- passing a materialised (Tq, Tk) mask as an operand
   would make checkpoint save it, stacking an O(L^2) bool residual
-  across the scan/ring. ``prevent_cse=False`` is for lax.scan bodies,
-  where scan already prevents the problematic CSE (per the
-  jax.checkpoint docs) and the default would only wall off fusion.
+  across the scan/ring. ``seg_q``/``seg_k`` are the two blocks'
+  (B, Tq)/(B, Tk) packed segment ids; the cross-segment mask (id
+  equality, the Pallas SegmentIds convention) is likewise rebuilt
+  inside the checkpointed region from the O(Tq + Tk) id operands.
+  ``prevent_cse=False`` is for lax.scan bodies, where scan already
+  prevents the problematic CSE (per the jax.checkpoint docs) and the
+  default would only wall off fusion.
   """
-  def inner(q_, k_, v_, m_, l_, o_, off):
+  def inner(q_, k_, v_, m_, l_, o_, off, sq, sk):
     if off is None:
       mask = None
     else:
@@ -162,10 +177,13 @@ def _block_update_remat(q, k, v, m, l, o, scale, offsets=None,
       qpos = q_off + jnp.arange(q_.shape[1])
       kpos = k_off + jnp.arange(k_.shape[1])
       mask = (qpos[:, None] >= kpos[None, :])[None, None]
+    if sq is not None:
+      seg_mask = (sq[:, :, None] == sk[:, None, :])[:, None]
+      mask = seg_mask if mask is None else (mask & seg_mask)
     return _block_update(q_, k_, v_, m_, l_, o_, scale, mask)
 
   return jax.checkpoint(inner, prevent_cse=prevent_cse)(
-      q, k, v, m, l, o, offsets)
+      q, k, v, m, l, o, offsets, seg_q, seg_k)
 
 
 def _scan_kv_blocks(q, k, v, m, l, o, scale, block: int, offsets):
@@ -386,7 +404,8 @@ def ring_attention_zigzag(q, k, v, axis_name: str = SEQ_AXIS,
 
 def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
                         scale: Optional[float] = None,
-                        q_block_size: Optional[int] = None):
+                        q_block_size: Optional[int] = None,
+                        segment_ids=None):
   """Single-device flash-style attention: lax.scan over K/V blocks with
   the same online softmax as the ring schedule, so forward peak memory
   is O(L * block) instead of O(L^2) and long contexts fit in HBM on one
@@ -407,6 +426,18 @@ def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
   future via lax.cond, recovering the ~2x of FLOPs the single-level
   path spends on fully-masked tiles.
 
+  ``segment_ids`` (B, L) int engages packed-sequence masking: queries
+  attend only keys of their own segment (id equality, the Pallas
+  SegmentIds convention -- padding id 0 attends padding, so no row is
+  ever fully masked). The two-level path additionally SKIPS any K/V
+  tile that is fully cross-segment for EVERY batch row (per-block
+  segment-id min/max interval test via lax.cond) -- first-fit packing
+  lays segments contiguously with padding at the row tail, so most
+  (q block, kv block) pairs outside the block-diagonal band carry no
+  same-segment pair and their matmuls are dead FLOPs; this is what
+  lets packing COMPOSE with the flash-style schedule instead of
+  falling back to a dense (L, L) mask.
+
   (B, L, H, D) -> (B, L, H, D); L % block_size == 0. Composes with
   ring_attention -- inside a ring step each device could scan its local
   block -- but is exposed standalone as the single-chip long-context
@@ -420,6 +451,13 @@ def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
 
   kb = k.reshape(b, nblk, block_size, h, d).swapaxes(0, 1)
   vb = v.reshape(b, nblk, block_size, h, d).swapaxes(0, 1)
+  segb = seg_min = seg_max = None
+  if segment_ids is not None:
+    # Per-KV-block segment ids (nblk, B, block) plus their per-row
+    # min/max -- the interval test the tile-skip cond keys on.
+    segb = segment_ids.reshape(b, nblk, block_size).swapaxes(0, 1)
+    seg_min = segb.min(axis=2)  # (nblk, B)
+    seg_max = segb.max(axis=2)
 
   if q_block_size is None:
     m0, l0, o0 = vary_like(
@@ -430,14 +468,18 @@ def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
 
     def step(carry, inp):
       m, acc_l, o = carry
-      j, kj, vj = inp
+      j, kj, vj, sj = inp
       offsets = (0, j * block_size) if causal else None
       m, acc_l, o = _block_update_remat(q, kj, vj, m, acc_l, o, scale_,
-                                        offsets, prevent_cse=False)
+                                        offsets, prevent_cse=False,
+                                        seg_q=(segment_ids
+                                               if segb is not None
+                                               else None),
+                                        seg_k=sj)
       return (m, acc_l, o), None
 
     (m, acc_l, o), _ = lax.scan(
-        step, (m0, l0, o0), (jnp.arange(nblk), kb, vb))
+        step, (m0, l0, o0), (jnp.arange(nblk), kb, vb, segb))
     out = o / jnp.maximum(acc_l, 1e-30).swapaxes(1, 2)[..., None]
     return out.astype(q.dtype)
 
@@ -446,9 +488,17 @@ def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
         f"seq len {l} not divisible by q block {q_block_size}")
   nq = l // q_block_size
   qb = q.reshape(b, nq, q_block_size, h, d).swapaxes(0, 1)
+  sqb = None
+  if segb is not None:
+    sqb = segment_ids.reshape(b, nq, q_block_size).swapaxes(0, 1)
 
   def q_step(_, q_inp):
-    qi, qi_blk = q_inp
+    if segb is None:
+      qi, qi_blk = q_inp
+      sq_blk = None
+    else:
+      qi, qi_blk, sq_blk = q_inp
+      q_min, q_max = sq_blk.min(axis=1), sq_blk.max(axis=1)  # (B,)
     acc0 = vary_like(
         q,
         (jnp.full((b, h, q_block_size), _NEG, jnp.float32),
@@ -456,29 +506,47 @@ def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
          jnp.zeros((b, q_block_size, h, d), jnp.float32)))
 
     def kv_step(carry, kv_inp):
-      j, kj, vj = kv_inp
+      if segb is None:
+        j, kj, vj = kv_inp
+        sj = None
+      else:
+        j, kj, vj, sj, k_min, k_max = kv_inp
 
       def do(c):
         offs = (qi * q_block_size, j * block_size) if causal else None
         return _block_update_remat(qi_blk, kj, vj, *c, scale_, offs,
-                                   prevent_cse=False)
+                                   prevent_cse=False, seg_q=sq_blk,
+                                   seg_k=sj)
 
+      has_work = None
       if causal:
         # K/V block j is strictly in this q block's future iff its
         # first key position exceeds the q block's last row.
         has_work = j * block_size <= qi * q_block_size + (
             q_block_size - 1)
+      if segb is not None:
+        # The tile is fully cross-segment when NO batch row's q-block
+        # segment interval intersects its kv-block interval (segments
+        # are contiguous per row, so min/max intervals are exact);
+        # such a tile is all-masked and its matmuls are skipped.
+        seg_work = jnp.any((k_min <= q_max) & (k_max >= q_min))
+        has_work = seg_work if has_work is None else (has_work &
+                                                      seg_work)
+      if has_work is not None:
         carry = lax.cond(has_work, do, lambda c: c, carry)
       else:
         carry = do(carry)
       return carry, None
 
-    (m, acc_l, o), _ = lax.scan(
-        kv_step, acc0, (jnp.arange(nblk), kb, vb))
+    kv_xs = ((jnp.arange(nblk), kb, vb) if segb is None else
+             (jnp.arange(nblk), kb, vb, segb, seg_min, seg_max))
+    (m, acc_l, o), _ = lax.scan(kv_step, acc0, kv_xs)
     out = o / jnp.maximum(acc_l, 1e-30).swapaxes(1, 2)[..., None]
     return None, out
 
-  _, outs = lax.scan(q_step, None, (jnp.arange(nq), qb))
+  q_xs = ((jnp.arange(nq), qb) if segb is None else
+          (jnp.arange(nq), qb, sqb))
+  _, outs = lax.scan(q_step, None, q_xs)
   # (nq, B, qb, H, D) -> (B, L, H, D)
   return outs.swapaxes(0, 1).reshape(b, l, h, d).astype(q.dtype)
 
@@ -537,17 +605,37 @@ def uniform_flash_block_sizes(block: int):
 
 def pallas_flash_attention(q, k, v, causal: bool = False,
                            scale: Optional[float] = None,
-                           block_sizes=None, block: Optional[int] = None):
+                           block_sizes=None, block: Optional[int] = None,
+                           segment_ids=None,
+                           cpu_fallback: Optional[bool] = None):
   """JAX's TPU Pallas flash-attention kernel behind this module's
   (B, L, H, D) layout -- the hand-tiled alternative to the XLA-scan
   blockwise schedule, for A/B measurement on hardware
   (experiments/long_context_probe.py --impls flash).
 
-  TPU-only: the kernel (jax.experimental.pallas.ops.tpu.
-  flash_attention) has no CPU lowering, so CPU suites exercise only
-  the layout plumbing. Differentiable -- the library ships fused
-  dq/dkv backward kernels via custom_vjp.
+  ``segment_ids`` (B, L) int rides the kernel's native SegmentIds
+  support (packed sequences): the kernel masks cross-segment tiles and
+  skips fully-masked blocks inside its own grid schedule, so packing
+  composes with the hand-tiled path without a dense (L, L) mask.
+
+  The kernel itself (jax.experimental.pallas.ops.tpu.flash_attention)
+  has no CPU lowering. ``cpu_fallback=None`` (the default) therefore
+  routes non-TPU backends to ``full_attention`` with the identical
+  mask semantics -- the kernel's own reference form -- so CPU suites
+  can EXECUTE flash-configured models (the packed-sequence oracle
+  tests), not just trace them; ``False`` forces the kernel path (the
+  trace-level BlockSizes drift guard wants the real call graph), and
+  ``True`` forces the reference path on any backend. Differentiable on
+  both paths -- the library ships fused dq/dkv backward kernels via
+  custom_vjp.
   """
+  if cpu_fallback is None:
+    cpu_fallback = jax.default_backend() != "tpu"
+  d = q.shape[-1]
+  scale = (1.0 / math.sqrt(d)) if scale is None else scale
+  if cpu_fallback:
+    return full_attention(q, k, v, causal=causal, scale=scale,
+                          segment_ids=segment_ids)
   from jax.experimental.pallas.ops.tpu import flash_attention as fa
   if block is not None:
     if block_sizes is not None:
@@ -558,11 +646,12 @@ def pallas_flash_attention(q, k, v, causal: bool = False,
     # (advisor round-5).
     block_sizes = uniform_flash_block_sizes(
         min(block, q.shape[1], k.shape[1]))
-  d = q.shape[-1]
-  scale = (1.0 / math.sqrt(d)) if scale is None else scale
+  seg = None
+  if segment_ids is not None:
+    seg = fa.SegmentIds(q=segment_ids, kv=segment_ids)
   qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
-  out = fa.flash_attention(qt, kt, vt, causal=causal, sm_scale=scale,
-                           block_sizes=block_sizes)
+  out = fa.flash_attention(qt, kt, vt, None, seg, causal=causal,
+                           sm_scale=scale, block_sizes=block_sizes)
   return out.swapaxes(1, 2).astype(q.dtype)
 
 

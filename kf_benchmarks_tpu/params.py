@@ -57,6 +57,38 @@ flags.DEFINE_integer("num_grad_accum", 1,
                      "--steps_per_dispatch (dispatch chunking outside, "
                      "microbatching inside). 1 = the monolithic step.",
                      lower_bound=1)
+flags.DEFINE_boolean("packed_sequences", False,
+                     "Variable-length sequence packing for "
+                     "transformer_lm (the standard LM-pretraining "
+                     "input form; no reference analog -- its inputs "
+                     "are fixed-shape images): a deterministic "
+                     "host-side first-fit bin-packer (data/packing.py) "
+                     "draws variable-length documents from a seeded "
+                     "length distribution and packs them into (B, T) "
+                     "rows with segment ids + per-document positions; "
+                     "segment-aware masks run through BOTH attention "
+                     "implementations (block-level cross-segment tile "
+                     "skip, parallel/sequence.py), the chunked fused "
+                     "loss weighs real tokens only (ops/fused_loss.py) "
+                     "and step metrics combine token-weighted "
+                     "(train_step.py). Batches stream through the "
+                     "DeviceFeeder (prefetch overlap measured via "
+                     "feed_stall_fraction). transformer_lm training "
+                     "only; composes with --steps_per_dispatch/"
+                     "--num_grad_accum/--overlap_gradient_reduction; "
+                     "exclusions in validation.py.")
+flags.DEFINE_integer("input_prefetch_depth", None,
+                     "Host->device prefetch depth of the DeviceFeeder "
+                     "in batches (the StagingArea/MultiDeviceIterator "
+                     "buffer depth analog, ref: benchmark_cnn.py:"
+                     "2572-2600, preprocessing.py:368-399). None = "
+                     "derived: max(--datasets_prefetch_buffer_size, "
+                     "--batch_group_size), the historical default. "
+                     "The measured consumer-side knob: "
+                     "feed_stall_fraction in the benchmark stats / "
+                     "bench JSON shows whether the depth hides host "
+                     "preprocessing behind device compute.",
+                     lower_bound=1)
 flags.DEFINE_integer("num_batches", None,
                      "Number of timed batches to run (ref :137-139).")
 flags.DEFINE_float("num_epochs", None,

@@ -30,6 +30,7 @@ benchmark_one_step). Design:
 from __future__ import annotations
 
 import functools
+import math
 from typing import Any, Callable, Optional
 
 import jax
@@ -227,6 +228,14 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
   health_stats = (bool(getattr(params, "health_stats", None)) and
                   not getattr(strategy, "sequential_apply", False) and
                   not sharded_state)
+  # --packed_sequences (models/transformer_lm.py): the model exposes
+  # images -> (B, T) per-token loss weights; the cross-replica metric
+  # combine then weights each replica by ITS real-label count (token-
+  # weighted, not replica-weighted -- replicas pack different document
+  # mixes), with the weighted loss terms PACKED into one vector pmean
+  # so the packed program carries no more collectives than the
+  # unpacked one (the lm_packed audit rule pins this).
+  token_weight_fn = getattr(model, "token_weight_fn", None)
   # Top-level param-tree keys whose gradients the MODULE already
   # reduces in-backward (e.g. transformer_lm's scanned 'blocks' stack
   # hooks per layer inside the nn.scan); the step-level buckets skip
@@ -351,6 +360,7 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
       return scaled, (base_loss, total_loss, new_bs, result)
 
     accum_acc_metrics = None
+    accum_tok_w = None
     if num_grad_accum > 1:
       # Microbatched accumulation (--num_grad_accum=M): one scan
       # iteration per microbatch, so the compiled program carries ONE
@@ -386,25 +396,52 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
 
       g0 = _vary(jax.tree.map(
           lambda p: jnp.zeros(p.shape, jnp.float32), forward_params))
-      bl0, tl0 = _vary((jnp.zeros((), jnp.float32),
-                        jnp.zeros((), jnp.float32)))
+      bl0, tl0, w0 = _vary((jnp.zeros((), jnp.float32),
+                            jnp.zeros((), jnp.float32),
+                            jnp.zeros((), jnp.float32)))
       bs0 = _vary(batch_stats)
 
       def mb_body(carry, xs):
-        g_acc, bl_acc, tl_acc, acc_acc, bs = carry
+        g_acc, bl_acc, tl_acc, w_acc, acc_acc, bs = carry
         imgs, lbls, idx = xs
         # Distinct dropout stream per microbatch (a shared one would
         # correlate masks across the effective batch).
         rng_i = jax.random.fold_in(step_rng, idx)
         g, (bl, tl, bs_next, result) = grad_fn(forward_params, imgs,
                                                lbls, bs, rng_i)
-        g_acc = jax.tree.map(lambda a, x: a + x.astype(jnp.float32),
-                             g_acc, g)
+        # --packed_sequences: each microbatch's loss is its own
+        # token-MEAN (ops/fused_loss.py); weight the accumulation by
+        # the microbatch's real-label count so the accumulated step is
+        # the PER-REPLICA monolithic token-weighted estimator -- sum
+        # over tokens / total tokens -- not a mean-of-means over
+        # unevenly packed microbatches. Deliberate scope: the CROSS-
+        # replica gradient exchange stays the equal-weight pmean
+        # (replicas' token counts concentrate tightly at ~97% packing,
+        # and token-weighting the exchange would rebuild every pinned
+        # reduction path -- strategies, overlap hooks, the sharded
+        # scatter -- for a second-order correction), so the optimized
+        # objective weights replicas equally while the REPORTED metrics
+        # are exactly token-weighted (pmean(loss*w)/pmean(w) below).
+        # Unpacked runs keep mb_w = 1 (the exact legacy equal-weight
+        # program).
+        if token_weight_fn is None:
+          # Exact legacy equal-weight accumulation (bit-pinned).
+          mb_w = jnp.float32(1.0)
+          g_acc = jax.tree.map(lambda a, x: a + x.astype(jnp.float32),
+                               g_acc, g)
+          wb, wt = bl, tl
+        else:
+          mb_w = jnp.sum(token_weight_fn(imgs))
+          g_acc = jax.tree.map(
+              lambda a, x: a + x.astype(jnp.float32) * mb_w, g_acc, g)
+          wb, wt = bl * mb_w, tl * mb_w
         if acc_acc is not None:
           mb_acc = model.accuracy_function(result, lbls)
-          acc_acc = {k: acc_acc[k] + v for k, v in mb_acc.items()
-                     if k in acc_acc}
-        return (g_acc, bl_acc + bl, tl_acc + tl, acc_acc, bs_next), None
+          acc_acc = {k: acc_acc[k] + (v if token_weight_fn is None
+                                      else v * mb_w)
+                     for k, v in mb_acc.items() if k in acc_acc}
+        return (g_acc, bl_acc + wb, tl_acc + wt,
+                w_acc + mb_w, acc_acc, bs_next), None
 
       acc0 = None
       if want_acc:
@@ -416,16 +453,27 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
                         batch_stats, step_rng)[1][3], lb0))
         acc0 = _vary({k: jnp.zeros((), jnp.float32)
                       for k, v in shapes.items() if not v.shape})
-      (g_acc, bl_acc, tl_acc, acc_acc, new_bs), _ = lax.scan(
-          mb_body, (g0, bl0, tl0, acc0, bs0),
+      (g_acc, bl_acc, tl_acc, w_sum, acc_acc, new_bs), _ = lax.scan(
+          mb_body, (g0, bl0, tl0, w0, acc0, bs0),
           (mb_images, mb_labels, jnp.arange(m)))
-      grads = jax.tree.map(lambda a, p: (a / m).astype(p.dtype),
+      # Normalizer: microbatch count on the legacy path; the summed
+      # real-label count on the packed path (w_sum = sum of mb_w), so
+      # gradients and losses come out as the monolithic token-weighted
+      # estimator up to float reassociation of the batch split.
+      norm = (jnp.float32(m) if token_weight_fn is None
+              else jnp.maximum(w_sum, 1.0))
+      if token_weight_fn is not None:
+        # The scan's summed per-microbatch counts ARE this batch's
+        # real-label total (0/1 weights in exact f32 integer range):
+        # reused at metrics time so the two normalizers cannot drift.
+        accum_tok_w = w_sum
+      grads = jax.tree.map(lambda a, p: (a / norm).astype(p.dtype),
                            g_acc, forward_params)
-      base_loss = bl_acc / m
-      total_loss = tl_acc / m
+      base_loss = bl_acc / norm
+      total_loss = tl_acc / norm
       net_result = None
       if acc_acc is not None:
-        accum_acc_metrics = {k: v / m for k, v in acc_acc.items()}
+        accum_acc_metrics = {k: v / norm for k, v in acc_acc.items()}
     else:
       grads, (base_loss, total_loss, new_bs, net_result) = jax.grad(
           loss_fn, has_aux=True)(forward_params, images, labels,
@@ -571,6 +619,16 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
       normal_steps = state.loss_scale_normal_steps
 
     lr = lr_fn(state.step)
+    # Token-weighted metric combine (--packed_sequences): this
+    # replica's real-label count; per-replica losses are already
+    # normalized by it (ops/fused_loss.py), so the global token-mean is
+    # pmean(loss * w) / pmean(w) -- computed from the SAME packed
+    # vector collective that carries the losses.
+    tok_w = None
+    if token_weight_fn is not None:
+      tok_w = (accum_tok_w if accum_tok_w is not None
+               else jnp.sum(token_weight_fn(images)))
+    wm_safe = None
     if health_stats:
       # In-step health stats (telemetry.py): grad norm, update/param
       # ratio, non-finite leaf count, loss scale + skip flag -- all
@@ -593,19 +651,43 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
       # update on the non-relaxed path (the relaxed bank admits finite
       # gradients only, so its apply always lands).
       suppressed = jnp.float32(0.0) if relaxed else skipped
-      packed = lax.pmean(
-          jnp.concatenate([
-              jnp.stack([base_loss.astype(jnp.float32),
-                         total_loss.astype(jnp.float32)]),
-              telemetry_lib.health_partials(
-                  grads, model_params, updates, axis_data)]),
-          axis_data)
+      # Under --packed_sequences the two loss slots ride token-weighted
+      # (loss * w) and w itself is appended to the SAME vector, so the
+      # weighted combine still costs the one loss pmean.
+      bl32 = base_loss.astype(jnp.float32)
+      tl32 = total_loss.astype(jnp.float32)
+      loss_slots = (jnp.stack([bl32, tl32]) if tok_w is None else
+                    jnp.stack([bl32 * tok_w, tl32 * tok_w]))
+      vec = [loss_slots, telemetry_lib.health_partials(
+          grads, model_params, updates, axis_data)]
+      if tok_w is not None:
+        vec.append(jnp.stack([tok_w]))
+      packed = lax.pmean(jnp.concatenate(vec), axis_data)
+      health_totals = packed[2:] if tok_w is None else packed[2:-1]
+      if tok_w is None:
+        bl_m, tl_m = packed[0], packed[1]
+      else:
+        wm_safe = jnp.maximum(packed[-1], 1e-30)
+        bl_m, tl_m = packed[0] / wm_safe, packed[1] / wm_safe
       metrics = {
-          "base_loss": packed[0],
-          "total_loss": packed[1],
+          "base_loss": bl_m,
+          "total_loss": tl_m,
           "learning_rate": lr,
           "health": telemetry_lib.health_finalize(
-              packed[2:], new_scale, skipped, suppressed),
+              health_totals, new_scale, skipped, suppressed),
+      }
+    elif tok_w is not None:
+      # One 3-vector pmean replaces the two scalar loss pmeans: the
+      # packed program's collective count stays <= the unpacked one.
+      packed = lax.pmean(
+          jnp.stack([base_loss.astype(jnp.float32) * tok_w,
+                     total_loss.astype(jnp.float32) * tok_w, tok_w]),
+          axis_data)
+      wm_safe = jnp.maximum(packed[2], 1e-30)
+      metrics = {
+          "base_loss": packed[0] / wm_safe,
+          "total_loss": packed[1] / wm_safe,
+          "learning_rate": lr,
       }
     else:
       # Metric pmeans reduce over the DATA axis only: model-axis peers
@@ -617,6 +699,13 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
           "total_loss": lax.pmean(total_loss, axis_data),
           "learning_rate": lr,
       }
+    if tok_w is not None and wm_safe is not None:
+      # Label coverage of the packed batch (real label positions /
+      # slots): the in-step packing-efficiency signal next to the
+      # host-side feed line (observability.packing_feed_line). Post-
+      # collective scalar math, no extra communication.
+      metrics["real_token_fraction"] = wm_safe / jnp.float32(
+          sum(math.prod(l.shape) for l in jax.tree.leaves(labels)) or 1)
     if steps_per_dispatch > 1:
       # Replica-mean global norm of the reduced gradients (under relaxed
       # consistency: of the APPLIED, one-step-stale bank) -- the
@@ -651,9 +740,14 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
              else model.accuracy_function(net_result, labels))
       # Scalars only: detection accuracy_functions also return per-box
       # arrays (decoded predictions), which are not replicated step
-      # metrics.
-      metrics.update({k: lax.pmean(v, axis_data)
-                      for k, v in acc.items() if jnp.ndim(v) == 0})
+      # metrics. Packed runs weight each replica's (already token-
+      # weighted) accuracy by its real-label count, like the losses.
+      if tok_w is not None and wm_safe is not None:
+        metrics.update({k: lax.pmean(v * tok_w, axis_data) / wm_safe
+                        for k, v in acc.items() if jnp.ndim(v) == 0})
+      else:
+        metrics.update({k: lax.pmean(v, axis_data)
+                        for k, v in acc.items() if jnp.ndim(v) == 0})
     if noise_stats is not None:
       metrics["noise_scale_g2"], metrics["noise_scale_s"] = noise_stats
 
@@ -735,11 +829,24 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
     result = BuildNetworkResult(logits=(logits, aux_logits))
     acc = model.accuracy_function(result, labels)
     loss = model.loss_function(result, labels)
-    metrics = {k: lax.pmean(v, axis_data)
-               for k, v in acc.items() if jnp.ndim(v) == 0}
-    # Loss included so the forward-only timed loop can print the standard
-    # step line (ref forward-only mode: benchmark_cnn.py:124-126).
-    metrics["base_loss"] = lax.pmean(loss, axis_data)
+    if token_weight_fn is not None:
+      # Packed runs (mid-training eval; --eval itself is rejected in
+      # validation.py): same token-weighted cross-replica combine as
+      # the train metrics -- each replica's loss/accuracy is already
+      # normalized by ITS real-label count, and replicas pack different
+      # document mixes, so an equal-weight pmean would bias the global
+      # value toward lightly-packed replicas.
+      tok_w = jnp.sum(token_weight_fn(images))
+      wm = jnp.maximum(lax.pmean(tok_w, axis_data), 1e-30)
+      metrics = {k: lax.pmean(v * tok_w, axis_data) / wm
+                 for k, v in acc.items() if jnp.ndim(v) == 0}
+      metrics["base_loss"] = lax.pmean(loss * tok_w, axis_data) / wm
+    else:
+      metrics = {k: lax.pmean(v, axis_data)
+                 for k, v in acc.items() if jnp.ndim(v) == 0}
+      # Loss included so the forward-only timed loop can print the
+      # standard step line (ref forward-only: benchmark_cnn.py:124-126).
+      metrics["base_loss"] = lax.pmean(loss, axis_data)
     metrics["total_loss"] = metrics["base_loss"]
     return metrics
 

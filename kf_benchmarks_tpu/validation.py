@@ -75,8 +75,9 @@ NO_CROSS_FLAG_VALIDATION = {
     "tf_random_seed": "seed value; any int is valid",
     "num_warmup_batches": "None = runtime default (benchmark.py:_run)",
     # Input pipeline knobs: consumed by data/ preprocessing with safe
-    # fallbacks; no cross-flag interaction.
-    "data_dir": "dataset path; synthetic when unset",
+    # fallbacks; no cross-flag interaction. (data_dir and
+    # use_synthetic_gpu_images left this list when --packed_sequences
+    # began cross-checking them.)
     "data_name": "dataset selector; inferred from data_dir when unset",
     "batch_group_size": "host pipeline batching depth",
     "distortions": "preprocessing toggle",
@@ -92,13 +93,14 @@ NO_CROSS_FLAG_VALIDATION = {
     "datasets_parallel_interleave_prefetch": "accepted for reference CLI "
                                              "parity; TF-pipeline-only",
     "datasets_prefetch_buffer_size": "feeder prefetch depth",
+    "input_prefetch_depth": "explicit feeder prefetch depth override "
+                            "(benchmark.feeder_prefetch); any depth "
+                            ">= 1 is valid with every input path",
     "datasets_repeat_cached_sample": "pipeline toggle",
     "datasets_sloppy_parallel_interleave": "accepted for reference CLI "
                                            "parity; TF-pipeline-only",
     "datasets_use_caching": "pipeline toggle",
     "datasets_use_prefetch": "pipeline toggle",
-    "use_synthetic_gpu_images": "forces synthetic inputs; benchmark.py "
-                                "consumes directly",
     "use_multi_device_iterator": "accepted for reference CLI parity; the "
                                  "DeviceFeeder is the only input path",
     "multi_device_iterator_max_buffer_size": "accepted for reference CLI "
@@ -238,6 +240,30 @@ def validate_cross_flags(params) -> None:
           "--num_grad_accum > 1 cannot be combined with "
           "--adaptive_batch_size: the policy re-picks the per-device "
           "batch mid-run and cannot guarantee divisibility by M")
+  if getattr(p, "packed_sequences", False):
+    # Packing re-shapes the LM input (tokens -> the (B, 3, T) packed
+    # stack) and re-weights losses by real-token count; only the
+    # segment-aware transformer_lm family consumes that form.
+    if p.model != "transformer_lm":
+      raise ParamError(
+          "--packed_sequences is a transformer_lm input form (segment-"
+          f"aware attention + weighted LM loss); got --model={p.model}. "
+          "The CNN/speech/recsys families have no variable-length "
+          "sequence axis to pack")
+    if p.eval or p.forward_only:
+      raise ParamError(
+          "--packed_sequences applies to training only (the packed "
+          "stream feeds the train loop); it cannot be combined with "
+          "--eval or --forward_only")
+    if p.data_dir and not p.use_synthetic_gpu_images:
+      raise ParamError(
+          "--packed_sequences draws documents from its seeded "
+          "synthetic length distribution (data/packing.py); packing a "
+          "real --data_dir corpus is not wired yet -- drop --data_dir "
+          "or add --use_synthetic_gpu_images")
+    # --elastic / --adaptive_batch_size compose: every reshape reopens
+    # the input stream (benchmark._open_input), and the packer is
+    # re-instantiated at the new row count/incarnation seed.
   mesh_shape = getattr(p, "mesh_shape", None)
   sharded = bool(getattr(p, "shard_optimizer_state", False))
   if mesh_shape:

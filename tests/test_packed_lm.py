@@ -1,0 +1,358 @@
+"""--packed_sequences device-side contracts: segment-aware attention
+(both implementations), the weighted fused loss, the packed-vs-solo
+per-document ORACLE, and train-step composition with
+--steps_per_dispatch / --num_grad_accum on the 8-device CPU mesh.
+
+The oracle's bit-identity condition: a masked-out attention tile is an
+EXACT identity update of the online-softmax accumulators, and weighted
+loss chunks add exact zeros outside a document -- so a packed
+document's loss is bit-identical to the same document alone PROVIDED
+the document's tokens occupy the same intra-tile offsets in both
+layouts. The tests therefore use tile-aligned document lengths
+(multiples of the attention/loss block) for the bit-identity pins and
+arbitrary lengths for the tolerance pins. The flash implementation
+executes on CPU through pallas_flash_attention's documented
+full-attention fallback (the Pallas kernel has no CPU lowering; the
+kernel's own call graph is still trace-pinned below).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kf_benchmarks_tpu import params as params_lib
+from kf_benchmarks_tpu import train_step as train_step_lib
+from kf_benchmarks_tpu import validation
+from kf_benchmarks_tpu.data import packing
+from kf_benchmarks_tpu.models import transformer_lm as lm
+from kf_benchmarks_tpu.models.model import BuildNetworkResult
+from kf_benchmarks_tpu.ops import fused_loss
+from kf_benchmarks_tpu.parallel import sequence as sequence_lib
+
+T, VOCAB, BLK = 256, 128, 64
+
+
+def _small_module(impl="tiled"):
+  return lm._TransformerLMModule(
+      vocab=VOCAB, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+      attn_block=BLK, attn_q_block=BLK, max_len=T, attn_impl=impl)
+
+
+def _packed_images(doc_lengths, seed=0, batch_size=1, seq_len=T):
+  rng = np.random.default_rng(seed)
+  docs = [rng.integers(1, VOCAB, size=int(n), dtype=np.int32)
+          for n in doc_lengths]
+  batches = list(packing.pack_documents(iter(docs), seq_len=seq_len,
+                                        batch_size=batch_size))
+  assert len(batches) == 1
+  return batches[0], docs
+
+
+def _doc_loss(module, variables, images, labels, segment: int):
+  """Per-document f32 NLL: the weighted fused loss restricted to one
+  segment's label positions (exact zeros elsewhere)."""
+  head, _ = module.apply(variables, jnp.asarray(images))
+  seg = jnp.asarray(images[:, 1])
+  w = packing.token_weights_from_segments(seg) * (seg == segment)
+  return float(fused_loss.fused_softmax_xent(
+      head.hidden, head.kernel, jnp.asarray(labels), chunk_size=BLK,
+      weights=w))
+
+
+# -- the oracle: packed == solo, per document, bitwise ------------------------
+
+# Tier note (round 13): the 870 s tier-1 wall was already past budget
+# on this host at the round-12 baseline, so the heavier jit-compiling
+# variants ride -m slow; one bit-identity oracle + one leakage probe
+# (the cheap flash-fallback arms) stay tier-1 as the representatives.
+@pytest.mark.parametrize("impl", [
+    pytest.param("tiled", marks=pytest.mark.slow), "flash"])
+def test_packed_per_document_losses_bit_identical_to_solo(impl):
+  """A packed batch of documents yields the SAME per-document f32
+  losses as running each document alone -- bit-identical, for both
+  attention implementations. Tile-aligned lengths (multiples of the
+  64-token attention/loss block), so packed offsets preserve each
+  document's intra-tile layout (see module docstring)."""
+  module = _small_module(impl)
+  packed, docs = _packed_images([BLK, 2 * BLK, BLK], seed=1)
+  variables = module.init({"params": jax.random.PRNGKey(0)},
+                          jnp.asarray(packed.images))
+  for s, doc in enumerate(docs, start=1):
+    (solo_batch,) = list(packing.pack_documents(iter([doc]), seq_len=T,
+                                                batch_size=1))
+    packed_loss = _doc_loss(module, variables, packed.images,
+                            packed.labels, s)
+    solo_loss = _doc_loss(module, variables, solo_batch.images,
+                          solo_batch.labels, 1)
+    assert packed_loss == solo_loss, (
+        f"{impl}: doc {s} packed {packed_loss!r} != solo {solo_loss!r}")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("impl", ["tiled", "flash"])
+def test_packed_per_document_losses_close_at_arbitrary_lengths(impl):
+  """Non-tile-aligned lengths shift documents' intra-tile offsets, so
+  the online-softmax/reduction association changes: equality holds to
+  float tolerance instead of bitwise."""
+  module = _small_module(impl)
+  packed, docs = _packed_images([50, 121, 37, 40], seed=2)
+  variables = module.init({"params": jax.random.PRNGKey(0)},
+                          jnp.asarray(packed.images))
+  for s, doc in enumerate(docs, start=1):
+    (solo_batch,) = list(packing.pack_documents(iter([doc]), seq_len=T,
+                                                batch_size=1))
+    packed_loss = _doc_loss(module, variables, packed.images,
+                            packed.labels, s)
+    solo_loss = _doc_loss(module, variables, solo_batch.images,
+                          solo_batch.labels, 1)
+    np.testing.assert_allclose(packed_loss, solo_loss, rtol=2e-5)
+
+
+# -- mask leakage: zero cross-segment attention -------------------------------
+
+@pytest.mark.parametrize("impl", [
+    pytest.param("tiled", marks=pytest.mark.slow), "flash"])
+def test_no_cross_segment_leakage(impl):
+  """Perturbing every token of one document must leave the OTHER
+  documents' per-document losses bit-unchanged: any nonzero
+  cross-segment attention weight would move them."""
+  module = _small_module(impl)
+  packed, docs = _packed_images([BLK, 2 * BLK, BLK], seed=3)
+  variables = module.init({"params": jax.random.PRNGKey(0)},
+                          jnp.asarray(packed.images))
+  mutated = packed.images.copy()
+  seg = mutated[:, 1]
+  doc2 = seg == 2
+  mutated[:, 0][doc2] = (mutated[:, 0][doc2] + 17) % VOCAB
+  for s in (1, 3):
+    before = _doc_loss(module, variables, packed.images, packed.labels, s)
+    after = _doc_loss(module, variables, mutated, packed.labels, s)
+    assert before == after, (
+        f"{impl}: doc {s} loss moved {before!r} -> {after!r} when doc 2 "
+        "changed -- cross-segment attention leaked")
+  # ... while doc 2's own loss DOES move (the probe has power).
+  assert _doc_loss(module, variables, packed.images, packed.labels, 2) \
+      != _doc_loss(module, variables, mutated, packed.labels, 2)
+
+
+# -- segment-aware attention vs the dense-mask reference ----------------------
+
+def test_blockwise_segment_mask_matches_full_attention():
+  rng = np.random.default_rng(4)
+  b, l, h, d = 2, 128, 2, 8
+  q, k, v = (jnp.asarray(rng.normal(size=(b, l, h, d)), jnp.float32)
+             for _ in range(3))
+  seg = np.zeros((b, l), np.int32)
+  seg[0, :40], seg[0, 40:90] = 1, 2           # 40+50 tokens + padding
+  seg[1, :100], seg[1, 100:] = 1, 2           # full row, two docs
+  seg = jnp.asarray(seg)
+  ref = sequence_lib.full_attention(q, k, v, causal=True,
+                                    segment_ids=seg)
+  for q_blk in (None, 32):
+    got = sequence_lib.blockwise_attention(
+        q, k, v, block_size=32, causal=True, q_block_size=q_blk,
+        segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+  # Differentiable through the tile-skip conds.
+  g = jax.grad(lambda q_: jnp.sum(sequence_lib.blockwise_attention(
+      q_, k, v, block_size=32, causal=True, q_block_size=32,
+      segment_ids=seg) ** 2))(q)
+  assert bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_flash_kernel_call_graph_with_segment_ids_traces_on_cpu():
+  # The Pallas kernel only RUNS on TPU; its segment_ids plumbing
+  # (fa.SegmentIds) must still TRACE on CPU with the fallback forced
+  # off, so a jax upgrade drifting the kernel API fails this suite,
+  # not the serialized hardware window.
+  b, l, h, d = 1, 256, 4, 64
+  q = jnp.zeros((b, l, h, d), jnp.float32)
+  seg = jnp.zeros((b, l), jnp.int32)
+  out = jax.eval_shape(
+      lambda q_, s: sequence_lib.pallas_flash_attention(
+          q_, q_, q_, causal=True, block=128, segment_ids=s,
+          cpu_fallback=False), q, seg)
+  assert out.shape == (b, l, h, d)
+
+
+# -- weighted fused loss units ------------------------------------------------
+
+def test_weighted_fused_loss_matches_manual_and_none_keeps_legacy():
+  rng = np.random.default_rng(5)
+  b, t, d, v = 2, 64, 16, 50
+  hidden = jnp.asarray(rng.normal(size=(b, t, d)), jnp.float32)
+  kernel = jnp.asarray(rng.normal(size=(d, v)), jnp.float32)
+  labels = jnp.asarray(rng.integers(0, v, size=(b, t)), jnp.int32)
+  w = jnp.asarray((rng.random((b, t)) > 0.3), jnp.float32)
+  logp = jax.nn.log_softmax(hidden @ kernel, axis=-1)
+  ll = jnp.take_along_axis(logp, labels[..., None], -1)[..., 0]
+  manual = -float(jnp.sum(ll * w) / jnp.sum(w))
+  got = float(fused_loss.fused_softmax_xent(hidden, kernel, labels,
+                                            chunk_size=16, weights=w))
+  np.testing.assert_allclose(got, manual, rtol=1e-6)
+  # weights=None keeps the exact legacy reduction (the pinned oracle).
+  legacy = float(fused_loss.fused_softmax_xent(hidden, kernel, labels,
+                                               chunk_size=16))
+  np.testing.assert_allclose(legacy, -float(jnp.mean(ll)), rtol=1e-6)
+  # Weighted top-k normalizes by the same real-token count.
+  acc = fused_loss.fused_top_k_accuracy(hidden, kernel, labels,
+                                        chunk_size=16, weights=w)
+  hits = (jnp.argmax(hidden @ kernel, -1) == labels).astype(jnp.float32)
+  np.testing.assert_allclose(float(acc["top_1_accuracy"]),
+                             float(jnp.sum(hits * w) / jnp.sum(w)),
+                             rtol=1e-6)
+
+
+def test_model_loss_dispatches_on_aux_weights_for_both_heads():
+  model = lm.TransformerLMModel()
+  model.LOSS_CHUNK = 16
+  rng = np.random.default_rng(6)
+  b, t, v = 2, 64, 50
+  logits = jnp.asarray(rng.normal(size=(b, t, v)), jnp.float32)
+  labels = jnp.asarray(rng.integers(0, v, size=(b, t)), jnp.int32)
+  w = jnp.asarray((rng.random((b, t)) > 0.5), jnp.float32)
+  logp = jax.nn.log_softmax(logits, axis=-1)
+  ll = jnp.take_along_axis(logp, labels[..., None], -1)[..., 0]
+  want = -float(jnp.sum(ll * w) / jnp.sum(w))
+  got = model.loss_function(
+      BuildNetworkResult(logits=(logits, w)), labels)
+  np.testing.assert_allclose(float(got), want, rtol=1e-6)
+  acc = model.accuracy_function(
+      BuildNetworkResult(logits=(logits, w)), labels)
+  hits = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+  np.testing.assert_allclose(float(acc["top_1_accuracy"]),
+                             float(jnp.sum(hits * w) / jnp.sum(w)),
+                             rtol=1e-6)
+
+
+# -- train-step composition on the 8-device mesh ------------------------------
+
+class _SmallPackedLM(lm.TransformerLMModel):
+  """The real packed TransformerLMModel contract at test scale: same
+  loss/metric/token_weight_fn wiring, small module dims so the 8-device
+  CPU mesh compiles in seconds."""
+
+  SEQ = 128
+
+  def __init__(self, params=None):
+    super().__init__(params=params)
+    self.set_batch_size(2)
+
+  def make_module(self, nclass, phase_train, data_format="NHWC",
+                  dtype=jnp.float32, param_dtype=jnp.float32):
+    del nclass, data_format
+    return lm._TransformerLMModule(
+        vocab=VOCAB, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        attn_block=32, attn_q_block=32, max_len=self.SEQ,
+        dtype=dtype, param_dtype=param_dtype)
+
+  def get_input_shapes(self, subset):
+    n = self.get_batch_size()
+    return [[n, 3, self.SEQ], [n, self.SEQ]]
+
+
+def _packed_step(params_overrides, seed=11):
+  import optax
+  from kf_benchmarks_tpu.parallel import strategies
+  from kf_benchmarks_tpu.parallel.mesh import build_mesh
+
+  overrides = dict(device="cpu", num_devices=8, batch_size=2,
+                   model="transformer_lm", packed_sequences=True,
+                   weight_decay=0.0)
+  overrides.update(params_overrides)
+  p = params_lib.make_params(**overrides)
+  validation.validate_cross_flags(p)
+  model = _SmallPackedLM(params=p)
+  module = model.make_module(0, True)
+  mesh = build_mesh(8, "cpu")
+  fns = train_step_lib.make_step_fns(
+      model, module, module, strategies.get_strategy(p),
+      optax.sgd(0.05), lambda s: jnp.float32(0.05), p, mesh)
+  init_state, train_step, train_chunk = fns[0], fns[1], fns[4]
+  stream = packing.PackedBatchStream(_SmallPackedLM.SEQ, 8 * 2, VOCAB,
+                                     seed=seed)
+  sample = jnp.zeros((2, 3, _SmallPackedLM.SEQ), jnp.int32)
+  state = init_state(jax.random.PRNGKey(0), sample)
+  return state, train_step, train_chunk, stream
+
+
+@pytest.mark.slow
+def test_packed_step_losses_bit_identical_across_steps_per_dispatch():
+  """K=2 scans the SAME per-replica packed step, so per-step losses
+  (token-weighted combine included) are bit-identical to K=1 on the
+  same stream -- the packed program composes with the device-resident
+  dispatch chunking."""
+  state1, step1, _, stream1 = _packed_step({})
+  losses_k1, batches = [], []
+  for _ in range(4):
+    images, labels = next(stream1)
+    batches.append((jnp.asarray(images), jnp.asarray(labels)))
+    state1, m = step1(state1, *batches[-1])
+    losses_k1.append(float(m["total_loss"]))
+    assert 0.0 < float(m["real_token_fraction"]) <= 1.0
+
+  state2, _, chunk2, _ = _packed_step({"steps_per_dispatch": 2})
+  losses_k2 = []
+  for c in range(2):
+    ims = jnp.stack([batches[2 * c][0], batches[2 * c + 1][0]])
+    lbs = jnp.stack([batches[2 * c][1], batches[2 * c + 1][1]])
+    state2, m = chunk2(state2, ims, lbs)
+    losses_k2.extend(float(x) for x in np.asarray(m["total_loss"]))
+  assert losses_k1 == losses_k2, (losses_k1, losses_k2)
+
+
+@pytest.mark.slow
+def test_packed_accum_matches_monolithic_token_weighted_estimator():
+  """--num_grad_accum on a packed batch weights each microbatch by its
+  real-label count (train_step.py mb_body), so the accumulated loss
+  AND the trained state match the monolithic packed step up to float
+  reassociation of the batch split -- NOT the mean-of-means a naive
+  equal-weight accumulation would produce over unevenly packed
+  microbatches."""
+  state1, step1, _, stream = _packed_step({})
+  state2, step2, _, _ = _packed_step({"num_grad_accum": 2})
+  for _ in range(3):
+    images, labels = next(stream)
+    images, labels = jnp.asarray(images), jnp.asarray(labels)
+    state1, m1 = step1(state1, images, labels)
+    state2, m2 = step2(state2, images, labels)
+    np.testing.assert_allclose(float(m1["total_loss"]),
+                               float(m2["total_loss"]), rtol=1e-6)
+  for l1, l2 in zip(jax.tree.leaves(state1.params),
+                    jax.tree.leaves(state2.params)):
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                               rtol=1e-5, atol=1e-7)
+
+
+# -- e2e: the benchmark loop with --packed_sequences --------------------------
+
+@pytest.mark.slow
+def test_packed_benchmark_e2e_prints_feed_line_and_stats():
+  """The full-size packed transformer_lm through BenchmarkCNN on the
+  CPU mesh: standard step lines, the input-pipeline line (packing
+  efficiency + feed stall), and the stats fields the bench JSON
+  forwards. Slow tier: full-size LM compile on CPU."""
+  from kf_benchmarks_tpu import benchmark
+  from kf_benchmarks_tpu.utils import log as log_util
+  logs = []
+  orig = log_util.log_fn
+  log_util.log_fn = logs.append
+  try:
+    p = params_lib.make_params(
+        model="transformer_lm", packed_sequences=True, device="cpu",
+        num_devices=2, batch_size=1, num_batches=3,
+        num_warmup_batches=1, display_every=1, input_prefetch_depth=3,
+        steps_per_dispatch=2)
+    stats = benchmark.BenchmarkCNN(p).run()
+  finally:
+    log_util.log_fn = orig
+  assert stats["packing_efficiency"] is not None
+  assert stats["packing_efficiency"] > 0.7
+  assert stats["feed_stall_fraction"] is not None
+  feed_lines = [l for l in logs if l.startswith("input pipeline:")]
+  assert len(feed_lines) == 1
+  assert "packing efficiency" in feed_lines[0]
+  assert "feed stall" in feed_lines[0]
+  assert np.isfinite(stats["last_average_loss"])

@@ -229,6 +229,25 @@ MUTATIONS = [
          opt_state_bytes_per_device=(
              c.aux["opt_state_bytes_per_device"] * c.aux["num_devices"])),
      "sharded-opt-bytes"),
+    # PR 8 seeds. The packed vector pmean REPLACED two scalar loss
+    # pmeans (one fewer all-reduce than the unpacked twin), so two
+    # scalar extras break the kind-count bound unambiguously; scalars
+    # stay out of gradient traffic, so only the count check fires.
+    ("packed_extra_metric_collectives", "lm_packed",
+     lambda c: (_add_collective(c, scalar=True, elems=1),
+                _add_collective(c, scalar=True, elems=1)),
+     "packed-no-overhead"),
+    # A new GRADIENT collective lands exactly at the twin's all-reduce
+    # count (17 + 1 == 18), so only the gradient-count half bites --
+    # the packed path must not touch the gradient exchange.
+    ("packed_gradient_exchange_drift", "lm_packed",
+     lambda c: _add_collective(c),
+     "packed-no-overhead"),
+    # Losing the (B, T, V) bound aux silently unbinds rule_no_btv_buffer
+    # on the packed program; the packed rule pins the aux's presence.
+    ("packed_btv_aux_lost", "lm_packed",
+     lambda c: c.aux.pop("btv_bytes"),
+     "packed-no-overhead"),
 ]
 
 
