@@ -135,6 +135,28 @@ def main():
       # record it uniformly (_CPU_FALLBACK semantics unchanged).
       "feed_stall_fraction": stats.get("feed_stall_fraction"),
   }
+  # Streaming latency percentiles + compile ledger (tracing.py): the
+  # SLO-telemetry and compile-cache groundwork fields (ROADMAP items 2
+  # and 5). Seconds, like compile_s; None when the run produced no
+  # samples of a key (e.g. feed_wait on the resident synthetic batch,
+  # which has no feeder). _CPU_FALLBACK semantics intact: both fields
+  # describe whatever run actually executed.
+  lat = stats.get("latency_percentiles") or {}
+
+  def _r6(v):
+    return round(v, 6) if v is not None else None
+
+  record["latency_percentiles"] = {
+      "chunk_wall_p50": _r6(lat.get("chunk_wall_p50")),
+      "chunk_wall_p90": _r6(lat.get("chunk_wall_p90")),
+      "chunk_wall_p99": _r6(lat.get("chunk_wall_p99")),
+      "feed_wait_p99": _r6(lat.get("feed_wait_p99")),
+  }
+  ledger = stats.get("compile_ledger") or {}
+  record["compile_ledger"] = {
+      "shapes": ledger.get("shapes", 0),
+      "total_compile_s": ledger.get("total_compile_s"),
+  }
   # Run-health summary (telemetry.py): BENCH_*.json records whether the
   # run was HEALTHY, not just fast -- a throughput number next to
   # nonfinite_steps > 0 or a watchdog stall is a different story than

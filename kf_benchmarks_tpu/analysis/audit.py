@@ -128,6 +128,33 @@ def rule_no_btv_buffer(contract, tracer):
   return []
 
 
+def rule_trace_twin(contract, tracer):
+  """PR 9: run tracing is HOST-ONLY. The trace-on step program
+  (--trace_events_file set, tracing.py) must be STRUCTURALLY IDENTICAL
+  to the trace-off twin -- full fingerprint identity (collective
+  inventory, wires, donation, optimizer scope, host transfers), not
+  just a collective-count bound: a device-side reduction, a host
+  transfer or a lost donation smuggled in by instrumentation is exactly
+  the regression this rule exists to catch."""
+  if not _cfg(contract, "trace_events_file"):
+    return []
+  if tracer is None:
+    return []
+  from kf_benchmarks_tpu.analysis import baseline as baseline_lib
+  twin_cfg = dict(contract.config)
+  twin_cfg.pop("trace_events_file")
+  twin = tracer(twin_cfg, contract.program)
+  on = baseline_lib.contract_fingerprint(contract)
+  off = baseline_lib.contract_fingerprint(twin)
+  # The config field differs by construction (it carries the flag).
+  on.pop("config", None)
+  off.pop("config", None)
+  return [
+      f"trace-on program differs from the trace-off twin at {field}: "
+      f"{off_v!r} (off) vs {on_v!r} (on) -- tracing must stay host-only"
+      for field, off_v, on_v in baseline_lib.diff_fingerprints(off, on)]
+
+
 def rule_health_no_extra_collective(contract, tracer):
   """PR 4: the health-on step carries NO additional collective (the
   stats ride the loss pmean)."""
@@ -433,6 +460,7 @@ def _tree_leaves(tree):
 
 
 RULES: Dict[str, Callable] = {
+    "trace-twin": rule_trace_twin,
     "accum-one-collective": rule_accum_one_collective,
     "overlap-in-backward": rule_overlap_in_backward,
     "no-btv-buffer": rule_no_btv_buffer,

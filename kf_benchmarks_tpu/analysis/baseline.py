@@ -15,6 +15,7 @@ bounds derived from the config, not pinned bytes.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 from collections import Counter
@@ -57,6 +58,56 @@ def contract_fingerprint(contract: ProgramContract) -> Dict[str, Any]:
 
 def _sorted_collectives(entries):
   return sorted(entries, key=lambda e: json.dumps(e, sort_keys=True))
+
+
+# Param fields that do NOT shape the compiled step program: artifact
+# sinks, cadences, and host-side-only observability/launcher knobs.
+# Excluded from the program-shape fingerprint so the compile ledger
+# (tracing.py) -- and the persistent compile cache it is groundwork for
+# (ROADMAP item 5) -- is not fragmented by paths and cadences that
+# change every run. Fields that DO reach the traced program (model,
+# batch, mesh, reducers, dtypes, accumulation, ...) all stay in.
+PROGRAM_SHAPE_EXCLUDE = frozenset({
+    "train_dir", "data_dir", "eval_dir", "benchmark_log_dir",
+    "benchmark_test_id", "trace_file", "trace_events_file",
+    "tfprof_file", "graph_file", "partitioned_graph_file_prefix",
+    "aot_save_path", "aot_load_path", "backbone_model_path",
+    "use_chrome_trace_format", "display_every", "save_model_secs",
+    "save_model_steps", "save_summaries_steps", "summary_verbosity",
+    "max_ckpts_to_keep", "eval_interval_secs",
+    "flight_recorder_window", "health_grad_norm_sigma",
+    "stall_watchdog_factor", "fault_schedule",
+    "elastic_check_every_n_steps", "sync_on_finish",
+})
+
+
+def fingerprint_key(payload: Dict[str, Any]) -> str:
+  """Short stable key of a canonical-JSON payload (sha256 hex, 16
+  chars) -- the identity scheme the compile ledger shares with the
+  golden fingerprints."""
+  canon = json.dumps(payload, sort_keys=True, default=str)
+  return hashlib.sha256(canon.encode("utf-8")).hexdigest()[:16]
+
+
+def config_fingerprint_key(config: Dict[str, Any],
+                           program: str = "train_step") -> str:
+  """The program-shape fingerprint key a compile episode is ledgered
+  under (tracing.py note_compile): the param fields that shape the
+  compiled program, plus the program name and the jax version (an XLA
+  upgrade recompiles everything, so a persistent cache must key on
+  it). Call it with the full ``params._asdict()`` (the ledger
+  convention: two runs key equal iff every program-shaping field --
+  explicit or default -- agrees); None values and the excluded
+  host-side fields drop out first."""
+  shape = {k: v for k, v in config.items()
+           if v is not None and k not in PROGRAM_SHAPE_EXCLUDE}
+  try:
+    import jax
+    jax_version = jax.__version__
+  except Exception:  # pure-stdlib caller (lint harness)
+    jax_version = ""
+  return fingerprint_key({"config": shape, "program": program,
+                          "jax": jax_version})
 
 
 def diff_fingerprints(golden: Dict[str, Any], current: Dict[str, Any]

@@ -390,4 +390,15 @@ GOLDEN_CONFIGS: "OrderedDict[str, Dict[str, Any]]" = OrderedDict([
     ("sharded_rescale", dict(model="trivial", batch_size=4,
                              num_devices=4, optimizer="momentum",
                              shard_optimizer_state=True)),
+    # PR 9 (round 14): the twin-trace rule's anchor. Run tracing
+    # (--trace_events_file, tracing.py) is HOST-ONLY by contract: the
+    # trace-on step program must be STRUCTURALLY IDENTICAL to the
+    # trace-off one (audit.rule_trace_twin diffs the full fingerprint
+    # against the twin without the flag -- the same paired-trace
+    # pattern as rule_health_no_extra_collective, but exact identity
+    # rather than a collective-count bound). The path is never opened
+    # during tracing (the span session lives in the train LOOP, not
+    # the step program).
+    ("traced", dict(model="trivial", batch_size=4,
+                    trace_events_file="trace_events.json")),
 ])

@@ -36,6 +36,14 @@ Rules (ids):
   chain silences the flight-recorder post-mortem, or eats ctrl-C). A
   bare ``signal.signal(...)`` statement drops the old handler on the
   floor; the compliant form assigns it.
+* ``trace-event-emission`` -- run-trace span emission and timing
+  helpers are single-sourced in ``tracing.py`` (the same pattern as
+  the step-line rule): constructing Chrome trace-event dicts (a dict
+  literal carrying a ``"ph"`` or ``"traceEvents"`` key) or defining a
+  percentile/chrome-trace helper anywhere else in the package would
+  fork the trace schema the tests validate. READING profiler output
+  (``e.get("ph")``, observability.py) is fine -- only construction is
+  emission.
 * ``citation`` -- every top-level module (and subpackage) cites the
   reference ``file:line`` span it covers, with a reasoned allowlist
   for TPU-native-only modules (folded in from the former standalone
@@ -436,6 +444,58 @@ def rule_step_line_format(sources: List[_Source]) -> List[LintViolation]:
   return out
 
 
+# -- rule: trace-event-emission ----------------------------------------------
+
+# Trace-event construction markers: a dict literal carrying one of
+# these keys IS a Chrome trace event being built. Reads
+# (e.get("ph"), data["traceEvents"]) do not match -- only construction.
+_TRACE_EVENT_KEYS = {"ph", "traceEvents"}
+# Helper names whose definitions outside the home fork the timing
+# conventions the exported schema depends on.
+_TRACE_HELPER_NAMES = {"percentile", "percentiles", "chrome_events",
+                       "chrome_trace_events"}
+_TRACE_HOME = "kf_benchmarks_tpu/tracing.py"
+
+TRACE_EMISSION_ALLOWLIST: Dict[str, str] = {}
+
+
+def rule_trace_event_emission(sources: List[_Source]
+                              ) -> List[LintViolation]:
+  out, hits = [], set()
+  for src in sources:
+    if not (src.path.startswith("kf_benchmarks_tpu/")
+            or src.path == "bench.py"):
+      continue
+    if src.path == _TRACE_HOME or src.tree is None:
+      continue
+    findings = []
+    for node in ast.walk(src.tree):
+      if isinstance(node, ast.Dict):
+        keys = {k.value for k in node.keys
+                if isinstance(k, ast.Constant)
+                and isinstance(k.value, str)}
+        if keys & _TRACE_EVENT_KEYS:
+          findings.append((node.lineno,
+                           "Chrome trace-event dict constructed"))
+      elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+          and node.name in _TRACE_HELPER_NAMES:
+        findings.append((node.lineno,
+                         f"trace helper {node.name}() defined"))
+    for lineno, what in findings:
+      hits.add(src.path)
+      if src.path in TRACE_EMISSION_ALLOWLIST:
+        continue
+      out.append(LintViolation(
+          "trace-event-emission", src.path, lineno,
+          f"{what} outside {_TRACE_HOME}: span emission and timing "
+          "helpers are single-sourced there (the exported Chrome "
+          "schema is validated against that one writer; emit through "
+          "tracing.active() / import tracing.percentile instead)"))
+  out += _stale_allowlist("trace-event-emission", TRACE_EMISSION_ALLOWLIST,
+                          hits, {s.path for s in sources})
+  return out
+
+
 # -- rule: flag-validation ---------------------------------------------------
 
 def _registry_flags(src: _Source) -> List[str]:
@@ -569,6 +629,7 @@ RULES = {
     "kill-timeout": rule_kill_timeout,
     "signal-chain": rule_signal_chain,
     "step-line-format": rule_step_line_format,
+    "trace-event-emission": rule_trace_event_emission,
     "flag-validation": rule_flag_validation,
     "citation": rule_citation,
 }

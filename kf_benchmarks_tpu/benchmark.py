@@ -26,6 +26,7 @@ from kf_benchmarks_tpu import learning_rate
 from kf_benchmarks_tpu import observability
 from kf_benchmarks_tpu import optimizers
 from kf_benchmarks_tpu import telemetry as telemetry_lib
+from kf_benchmarks_tpu import tracing as tracing_lib
 from kf_benchmarks_tpu import train_step as train_step_lib
 from kf_benchmarks_tpu import validation
 from kf_benchmarks_tpu.data import datasets
@@ -140,7 +141,6 @@ _NOOP_PARITY_FLAGS = {
     "allreduce_merge_scope": ("ScopedAllocator merge hint; XLA schedules collectives itself " "(ref :561-566)"),
     "server_protocol": ("the coordination service speaks its own protocol " "(ref :578)"),
     "trt_max_workspace_size_bytes": ("TensorRT knob"),
-    "use_chrome_trace_format": ("jax.profiler writes its own " "trace format"),
     "xla": ("XLA is the only execution path on TPU"),
     "xla_compile": ("the whole step is always jitted"),
     "freeze_when_forward_only": ("freezing IS the AOT export; " "use --aot_save_path"),
@@ -360,6 +360,11 @@ class BenchmarkCNN:
       params = params._replace(health_stats=hs)
       self.params = params
     self._telemetry = None
+    # Run-trace session default: the no-op sink until _benchmark_train
+    # installs the real one (tracing.py) -- direct _train_loop callers
+    # (tests) trace nothing rather than crash.
+    self._trace = tracing_lib.NULL_TRACE
+    self._compiled_programs = set()
     # Deterministic fault injection (--fault_schedule, faults.py): the
     # named faults fire at dispatch boundaries; the dispatch planner
     # treats their steps as events so a chunk never crosses one.
@@ -694,20 +699,45 @@ class BenchmarkCNN:
     p = self.params
     if self._health_note:
       log_fn(self._health_note)
-    init_state, train_step, eval_step, broadcast_init, train_chunk = \
-        self._build()
-    rng = jax.random.PRNGKey(p.tf_random_seed or 0)
-    data_rng, init_rng = jax.random.split(rng)
-    self._data_rng = data_rng
-    next_batch = self._open_input(data_rng, "train")
-    # Flight recorder + stall watchdog for the whole build->train span
-    # (the watchdog's patient first-compile regime must cover the init
-    # and warmup compiles, not just the timed loop). None when the
-    # resolved --health_stats is off.
-    self._telemetry = telemetry_lib.TelemetrySession.create(
-        p, rank=cluster_lib.process_rank(), log_fn=log_fn,
-        num_ranks=max(self.num_workers, 1))
+    # Run-trace session (tracing.py): ONE run id shared with the flight
+    # recorder so a post-mortem dump lays over the timeline. Always
+    # created -- the latency percentiles and compile ledger ride the
+    # stats/bench JSON even without --trace_events_file (span retention
+    # and the file export engage only with the flag). Under kfrun the
+    # world size comes from the launcher env (jax.process_count() is 1
+    # per CPU worker there), so rank files and the rank-0 merge cover
+    # every worker of the job.
+    rank = cluster_lib.process_rank()
+    world = (int(os.environ.get("KFCOORD_WORLD") or 0) or
+             max(self.num_workers, 1))
+    run_id = tracing_lib.resolve_run_id()
+    self._trace = tracing_lib.RunTrace(
+        path=p.trace_events_file, rank=rank, num_ranks=world,
+        run_id=run_id, chrome_format=bool(p.use_chrome_trace_format),
+        log_fn=log_fn)
+    tracing_lib.activate(self._trace)
+    self._compiled_programs = set()
+    # Everything from the build on runs under the try: a raise anywhere
+    # (compile error, bad data_dir, sink failure) must still deactivate
+    # the module-global trace session (a leaked active session would
+    # swallow later emitters in this process) and export what was
+    # captured.
     try:
+      init_state, train_step, eval_step, broadcast_init, train_chunk = \
+          self._build()
+      rng = jax.random.PRNGKey(p.tf_random_seed or 0)
+      data_rng, init_rng = jax.random.split(rng)
+      self._data_rng = data_rng
+      next_batch = self._open_input(data_rng, "train")
+      # Flight recorder + stall watchdog for the whole build->train span
+      # (the watchdog's patient first-compile regime must cover the init
+      # and warmup compiles, not just the timed loop). None when the
+      # resolved --health_stats is off. Same launcher-derived world as
+      # the trace session: under kfrun jax.process_count() is 1 per CPU
+      # worker, and num_ranks=1 would silently disable the rank-0
+      # flight-recorder merge at exit.
+      self._telemetry = telemetry_lib.TelemetrySession.create(
+          p, rank=rank, log_fn=log_fn, num_ranks=world, run_id=run_id)
       return self._train_loop(init_state, train_step, eval_step,
                               broadcast_init, init_rng, next_batch,
                               train_chunk)
@@ -715,7 +745,17 @@ class BenchmarkCNN:
       if self._telemetry is not None:
         self._telemetry.close()
         self._telemetry = None
-      self._input_stop()
+      stop_input = getattr(self, "_input_stop", None)
+      if stop_input is not None:
+        stop_input()
+      # Deactivate AFTER the input stop (the feeder worker emits feed
+      # spans until it joins), then export: per-rank span file + the
+      # rank-0 multi-rank merge (tracing.py).
+      tracing_lib.deactivate()
+      try:
+        self._trace.export()
+      except Exception as e:  # an export failure must not eat the run
+        log_fn(f"trace export failed (non-fatal): {e!r}")
 
   def _open_input(self, rng, subset: str, bump: bool = True):
     """Open a fresh input stream, closing the previous one (elastic
@@ -792,6 +832,10 @@ class BenchmarkCNN:
         start_step=steps_done, start_examples=examples_done)
     init_state, train_step, eval_step, broadcast_init, train_chunk = \
         self._build()
+    # The rebuilt programs recompile at the new topology: their first
+    # dispatches are fresh compile-ledger episodes (the config
+    # fingerprint differs -- num_devices/mesh_shape changed).
+    self._compiled_programs = set()
     next_batch = self._open_input(self._data_rng, "train")
     shape = (batch_per_device,) + self._model_image_shape()
     new_state = init_state(init_rng, jnp.zeros(shape, jnp.float32))
@@ -806,12 +850,20 @@ class BenchmarkCNN:
     """The ONE checkpoint-write path: layout flag + the input-stream
     incarnation a resumed run must reopen at. ``incarnation_bump=1`` at
     the resize seam: the snapshot's resume point is the POST-resize
-    stream (the rebuild bumps the incarnation right after this save)."""
+    stream (the rebuild bumps the incarnation right after this save).
+    Also the ONE place checkpoint-save wall time enters the run trace
+    (span + p50/p90/p99 sample, tracing.py)."""
+    trace = tracing_lib.active()
+    t0 = trace.now()
     checkpoint.save_checkpoint(
         self.params.train_dir, state, self.params.max_ckpts_to_keep,
         sharded_opt_state=self._sharded_state,
         input_incarnation=getattr(self, "_input_incarnation", 0)
         + incarnation_bump)
+    dur = trace.now() - t0
+    trace.add_span("checkpoint", "save", t0, dur,
+                   {"incarnation_bump": incarnation_bump})
+    trace.add_sample("checkpoint_save", dur)
 
   def _verify_resumed_state(self, state) -> None:
     """Resume-time contract re-verification (analysis/audit.py): every
@@ -865,6 +917,7 @@ class BenchmarkCNN:
     # (ref: Supervisor auto-restore, benchmark_cnn.py:2122-2157).
     resumed = False
     if p.train_dir:
+      t_restore = self._trace.now()
       try:
         # Parse-once resolve that skips torn/corrupt files with a
         # logged warning (checkpoint.load_latest_checkpoint).
@@ -887,6 +940,9 @@ class BenchmarkCNN:
           images, labels = next_batch()
           log_fn(f"Resumed input stream at incarnation {snap_inc}")
         log_fn(f"Restored checkpoint at global step {ckpt_step}")
+        self._trace.add_span(
+            "checkpoint", "restore", t_restore,
+            self._trace.now() - t_restore, {"global_step": ckpt_step})
         resumed = True
       except checkpoint.CheckpointNotFoundException:
         pass
@@ -1043,8 +1099,22 @@ class BenchmarkCNN:
     # the measurement brackets the async fn call alone -- never the
     # trace drain.
     dispatch_stats = {"compile_s": None, "call_times": []}
+    trace = self._trace
 
-    def _traced(trace_file, idx, trace_at, fn, *args):
+    def _note_compile(label: str, wall_s: float) -> None:
+      """First host call of a jitted program blocks on trace+compile:
+      ledger the episode under the program-shape fingerprint key
+      (analysis/baseline.config_fingerprint_key -- the identity the
+      persistent compile cache of ROADMAP item 5 will share)."""
+      from kf_benchmarks_tpu.analysis import baseline as baseline_lib
+      self._compiled_programs.add(label)
+      trace.note_compile(
+          baseline_lib.config_fingerprint_key(self.params._asdict(),
+                                              label),
+          label, wall_s, model=self.model.get_name(),
+          num_devices=self.num_devices)
+
+    def _traced(trace_file, idx, trace_at, label, fn, *args):
       """One dispatch under the single-dispatch trace policy: trace it
       when ``idx == trace_at`` (warmup traces its LAST dispatch, ref
       :806-817 traces step -2 for the same reason; with zero warmup the
@@ -1052,20 +1122,31 @@ class BenchmarkCNN:
       inside the profiler context so the trace spans the device
       execution (utils/sync.py on why block_until_ready is not enough).
       The ONE place this invariant lives; every dispatch site routes
-      through it."""
+      through it. ``label`` names the dispatched program for the
+      dispatch-issue span and the compile ledger: the span brackets the
+      ASYNC jit call only (device completion is attributed
+      differentially from the pipeline arrival intervals in _handle --
+      never block_until_ready)."""
       with observability.maybe_trace_step(trace_file, idx, trace_at):
+        t0 = trace.now()
         t_call = time.monotonic()
         new_state, out_metrics = fn(*args)
         dt = time.monotonic() - t_call
+        first = label not in self._compiled_programs
+        trace.add_span("dispatch", label, t0, trace.now() - t0,
+                       {"step": idx, "first_call": first})
         if dispatch_stats["compile_s"] is None:
           dispatch_stats["compile_s"] = dt
         dispatch_stats["call_times"].append(dt)
+        if first:
+          _note_compile(label, dt)
         if trace_file and idx == trace_at:
           sync.drain(out_metrics)
       return new_state, out_metrics
 
     log_fn("Running warm up")
     t0 = time.time()
+    t0_warm = trace.now()
     cursor = 0  # consumed slices of the current staged real-data chunk
     if chunked:
       # Exactly num_warmup_batches warmup steps, like K=1: q whole
@@ -1082,12 +1163,13 @@ class BenchmarkCNN:
       w = 0
       for _ in range(q):
         state, metrics = _traced(p.trace_file, w, n_dispatches - 1,
-                                 train_chunk, state, images, labels)
+                                 "train_chunk", train_chunk, state,
+                                 images, labels)
         images, labels = next_batch()
         w += 1
       for _ in range(r):
         state, metrics = _traced(p.trace_file, w, n_dispatches - 1,
-                                 run_step, state,
+                                 "train_step", run_step, state,
                                  *_step_slice(images, labels, cursor))
         if not synthetic:
           cursor += 1
@@ -1102,7 +1184,8 @@ class BenchmarkCNN:
       for w in range(self.num_warmup_batches):
         state, metrics = _traced(p.trace_file, w,
                                  self.num_warmup_batches - 1,
-                                 run_step, state, images, labels)
+                                 "train_step", run_step, state, images,
+                                 labels)
         images, labels = next_batch()
       warm_steps = self.num_warmup_batches
       if self.num_warmup_batches and not p.trace_file:
@@ -1112,6 +1195,8 @@ class BenchmarkCNN:
         sync.drain(metrics)
     log_fn("Warmup (compile + %d steps): %.1f s" %
            (warm_steps, time.time() - t0))
+    trace.add_span("run", "warmup", t0_warm, trace.now() - t0_warm,
+                   {"steps": warm_steps})
     if tele is not None and self.num_warmup_batches:
       # First heartbeat: compile + warmup completed (the drain above is
       # a real value fetch, utils/sync.py) -- the watchdog leaves its
@@ -1147,6 +1232,17 @@ class BenchmarkCNN:
     # the mean/uncertainty/jitter stats (ref: benchmark_cnn.py:887-902).
     pipe = pipeline_lib.MetricsPipeline(lag=2)
 
+    # The device span of the dispatch currently resolving through
+    # _handle: opened at its FIRST completed step (every member carries
+    # the full chunk interval), shared by all K rows, closed at
+    # chunk_end -- so every flight-recorder row cross-links the span
+    # it lies inside. issue_walls pairs each resolving dispatch with
+    # ITS OWN host-issue wall: the pipeline resolves dispatches FIFO
+    # but lag-2 behind the issues, so call_times[-1] would belong to a
+    # LATER dispatch (and make the wall - issue differential lie).
+    dispatch_span = {"id": None}
+    issue_walls = []
+
     def _handle(done: "pipeline_lib.CompletedStep"):
       nonlocal loss, last_display_len
       step_train_times.append(done.interval)
@@ -1154,6 +1250,23 @@ class BenchmarkCNN:
         chunk_times.append(done.chunk_interval)
       m = done.metrics
       loss = float(m[p.loss_type_to_report])
+      if dispatch_span["id"] is None:
+        # Device completion attributed DIFFERENTIALLY: the pipeline's
+        # read-arrival interval is the dispatch's real wall (the lag-2
+        # fetch IS the sync signal, utils/pipeline.py); the SAME
+        # dispatch's host-issue share rides in the args so device time
+        # can be read as wall - issue (~70 ms tunnel RTT, the roofline
+        # discipline).
+        issue_s = issue_walls.pop(0) if issue_walls else None
+        t_now = trace.now()
+        dispatch_span["id"] = trace.add_span(
+            "device", "chunk" if done.chunk_len > 1 else "step",
+            t_now - done.chunk_interval, done.chunk_interval,
+            {"steps": done.chunk_len,
+             "end_step": start_step + done.index + done.chunk_len - 1
+             if not done.chunk_end else start_step + done.index,
+             "issue_ms": (round(issue_s * 1e3, 3)
+                          if issue_s is not None else None)})
       if tele is not None:
         # One flight-recorder row per STEP (chunked dispatches resolve
         # to per-step metrics host-side, utils/pipeline.py); heartbeat
@@ -1165,9 +1278,13 @@ class BenchmarkCNN:
             lr=m.get("learning_rate"), health=m.get("health"),
             wall_ms=done.interval * 1e3, chunk_len=done.chunk_len,
             rtt_ms=(dispatch_stats["call_times"][-1] * 1e3
-                    if dispatch_stats["call_times"] else None))
+                    if dispatch_stats["call_times"] else None),
+            span_id=dispatch_span["id"] or None)
         if done.chunk_end:
           tele.beat(done.chunk_interval)
+      if done.chunk_end:
+        trace.add_sample("chunk_wall", done.chunk_interval)
+        dispatch_span["id"] = None
       if noise_ema is not None and "noise_scale_g2" in m:
         noise_ema.update(float(m["noise_scale_g2"]),
                          float(m["noise_scale_s"]))
@@ -1276,8 +1393,9 @@ class BenchmarkCNN:
       # the FIRST timed dispatch, via _traced's trace_at == i == 0)
       timed_trace = p.trace_file if self.num_warmup_batches == 0 else None
       if use_chunk:
-        state, metrics = _traced(timed_trace, i, 0,
+        state, metrics = _traced(timed_trace, i, 0, "train_chunk",
                                  train_chunk, state, images, labels)
+        issue_walls.append(dispatch_stats["call_times"][-1])
         images, labels = next_batch()
         i += K
         images_processed += K * self.batch_size * max(self.num_workers, 1)
@@ -1285,8 +1403,10 @@ class BenchmarkCNN:
           _handle(done)
       else:
         for _ in range(n_dispatch):
-          state, metrics = _traced(timed_trace, i, 0, run_step, state,
+          state, metrics = _traced(timed_trace, i, 0, "train_step",
+                                   run_step, state,
                                    *_step_slice(images, labels, cursor))
+          issue_walls.append(dispatch_stats["call_times"][-1])
           if not chunked:
             images, labels = next_batch()
           elif not synthetic:
@@ -1331,8 +1451,18 @@ class BenchmarkCNN:
           last_save_time = time.time()
         if eval_due:
           # Mid-training eval + early stop (ref: benchmark_cnn.py:2310-2324).
-          acc = jax.device_get(
-              eval_step(state, *_step_slice(images, labels, cursor)))
+          t_eval = trace.now()
+          acc = eval_step(state, *_step_slice(images, labels, cursor))
+          # The ledger convention brackets the ASYNC first call only
+          # (blocks on trace+compile) -- the device_get below adds
+          # execution + transfer wall, which belongs to the eval span,
+          # not the compile episode.
+          eval_issue = trace.now() - t_eval
+          if "eval_step" not in self._compiled_programs:
+            _note_compile("eval_step", eval_issue)
+          acc = jax.device_get(acc)
+          trace.add_span("eval", "mid_train_eval", t_eval,
+                         trace.now() - t_eval, {"step": i})
           top1 = float(acc["top_1_accuracy"])
           log_fn("Accuracy @ 1 = %.4f Accuracy @ 5 = %.4f [%d examples]" %
                  (top1, float(acc["top_5_accuracy"]), self.batch_size))
@@ -1425,6 +1555,8 @@ class BenchmarkCNN:
                     max(self.num_workers, 1))
               except Exception as e:  # noqa: BLE001
                 log_fn(f"restart barrier failed ({e}); exiting anyway")
+              trace.instant("elastic", "checkpoint_restart", step=i,
+                            workers=restart_np)
               restart_requested = restart_np
               break
           new_bs = None
@@ -1473,6 +1605,7 @@ class BenchmarkCNN:
                        event["batch_size_per_device"]))
             old_mesh = "x".join(
                 str(int(s)) for s in self.mesh.devices.shape)
+            t_seam = trace.now()
             if p.train_dir:
               # Drain happened at the sync point above; snapshot to
               # disk BEFORE the rebuild, so a crash mid-rescale (or a
@@ -1509,12 +1642,23 @@ class BenchmarkCNN:
             log_fn("elastic event: generation %d: mesh %s -> %s, "
                    "resume step %d" % (generation, old_mesh, new_mesh,
                                        i))
+            # One span per generation on the elastic track: the whole
+            # seam (seam snapshot + mesh rebuild + re-jit + restore +
+            # contract re-verification), so the timeline shows where a
+            # resize's wall went.
+            trace.add_span(
+                "elastic", f"resize_gen{generation}", t_seam,
+                trace.now() - t_seam,
+                {"generation": generation, "mesh": event["mesh"],
+                 "resume_step": i})
             if tele is not None:
               tele.elastic_event(generation, old_mesh, new_mesh, i)
         pipe.note_aux_time(time.time() - aux_start)
     for done in pipe.flush():
       _handle(done)
     total_time = time.time() - loop_start
+    trace.add_span("run", "timed_loop", trace.now() - total_time,
+                   total_time, {"steps": len(step_train_times)})
     if controller is not None and controller is not self.elastic_controller:
       controller.close()
 
@@ -1593,6 +1737,18 @@ class BenchmarkCNN:
     # Final checkpoint (ref: benchmark_cnn.py:2374-2378).
     if p.train_dir:
       self._save_checkpoint(state)
+    # Streaming latency percentiles (chunk wall / feed wait / checkpoint
+    # save) + the compile ledger table -- AFTER the final save so the
+    # printed sample counts match the stats fields below; whole lines
+    # only (the scrape guard: nothing interleaves inside step lines).
+    # The ledger persists to train_dir/compile_ledger.json keyed on
+    # contract fingerprints (tracing.py; ROADMAP items 2 and 5).
+    for line in self._trace.latency_lines():
+      log_fn(line)
+    for line in self._trace.ledger_lines():
+      log_fn(line)
+    if p.train_dir:
+      self._trace.write_ledger(p.train_dir)
     if p.sync_on_finish:
       kungfu.run_barrier()
     # (ref stats dict: benchmark_cnn.py:2383-2391)
@@ -1639,6 +1795,14 @@ class BenchmarkCNN:
                                 if feed_stats else None),
         "packing_efficiency": (packing_stats["packing_efficiency"]
                                if packing_stats else None),
+        # Run-trace aggregates (tracing.py): flat <key>_p50/p90/p99
+        # seconds fields over chunk wall / feed wait / checkpoint save
+        # (SLO-telemetry groundwork, ROADMAP item 2) and the per-shape
+        # compile ledger (persistent-compile-cache groundwork, item 5).
+        # bench.py forwards both into its one-line JSON.
+        "latency_percentiles": self._trace.percentile_fields() or None,
+        "compile_ledger": self._trace.compile_ledger(),
+        "run_id": self._trace.run_id or None,
         "state": state,
     }
 

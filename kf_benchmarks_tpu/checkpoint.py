@@ -282,7 +282,14 @@ def restore_state(state, snapshot: dict, restore_opt_state: bool = True,
   params = _restack(state.params, snapshot["params"])
   if restore_opt_state:
     if snap_sharded:
-      new_opt = _reshard(state.opt_state, snapshot["opt_state"])
+      # Reshard cost on the run-trace checkpoint lane (tracing.py
+      # no-op sink without a session): the re-address of the (n, k)
+      # shard stack is a distinct, size-dependent slice of an elastic
+      # seam's wall that the timeline should show next to the save and
+      # the re-jit, not blended into "restore".
+      from kf_benchmarks_tpu import tracing
+      with tracing.active().span("checkpoint", "reshard_opt_state"):
+        new_opt = _reshard(state.opt_state, snapshot["opt_state"])
     else:
       new_opt = _restack(state.opt_state, snapshot["opt_state"])
   else:

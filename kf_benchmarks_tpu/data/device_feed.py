@@ -29,6 +29,7 @@ from typing import Iterator, Optional, Tuple
 import jax
 import numpy as np
 
+from kf_benchmarks_tpu import tracing
 from kf_benchmarks_tpu.parallel import mesh as mesh_lib
 
 
@@ -92,10 +93,20 @@ class DeviceFeeder:
       # preprocessing work happens, so a stopped feeder must not decode
       # another full global batch just to discard it.
       while not self._stop.is_set():
+        # Run-trace feed lane (tracing.py active session; no-op sink
+        # otherwise): "fetch" is the host preprocessing pull, "h2d" the
+        # device_put -- the producer half of the overlap question
+        # stats() answers from the consumer side.
+        trace = tracing.active()
+        t0 = trace.now()
         batch = self._pull(it)
         if batch is None:
           break
+        trace.add_span("feed", "fetch", t0, trace.now() - t0,
+                       {"chunk": self._chunk})
+        t1 = trace.now()
         device_batch = mesh_lib.put_batch(batch, self._sharding)
+        trace.add_span("feed", "h2d", t1, trace.now() - t1)
         while not self._stop.is_set():
           try:
             self._queue.put(device_batch, timeout=0.5)
@@ -112,6 +123,11 @@ class DeviceFeeder:
 
   def __next__(self):
     t0 = time.monotonic()
+    # The span anchor reads the TRACE clock (injectable; mixing it with
+    # raw monotonic would skew fake-clock tests, tracing.RunTrace.now);
+    # the stats/sample below keep the real monotonic measurement.
+    trace = tracing.active()
+    t0_trace = trace.now()
     if self._window_start is None:
       self._window_start = t0
     depth = self._queue.qsize()
@@ -134,9 +150,16 @@ class DeviceFeeder:
         raise self._error
       raise StopIteration
     now = time.monotonic()
-    self._wait_s += now - t0
+    waited = now - t0
+    self._wait_s += waited
     self._window_end = now
     self._fetches += 1
+    # Consumer-wait lane + percentile sample (tracing.py): every fetch
+    # feeds the feed_wait p50/p90/p99, and a traced run shows each wait
+    # as a span (bracketed on the trace clock captured at entry).
+    trace.add_span("feed", "wait", t0_trace, trace.now() - t0_trace,
+                   {"queue_depth": depth * self._chunk})
+    trace.add_sample("feed_wait", waited)
     # Queue depth in BATCH units (the queue itself holds chunks when
     # chunk > 1), so the number reads against prefetch_batches.
     self._depth_sum += depth * self._chunk

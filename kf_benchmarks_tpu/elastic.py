@@ -174,7 +174,14 @@ class ElasticController:
       return None
     self._last_target = target
     self.last_raw_target = target
-    return max(1, min(target, self._max_devices))
+    clamped = max(1, min(target, self._max_devices))
+    # Run-trace marker at the poll that first SURFACED the resize (the
+    # seam span itself is recorded by the benchmark driver around the
+    # rebuild): the timeline then shows poll-to-reseam latency.
+    from kf_benchmarks_tpu import tracing
+    tracing.active().instant("elastic", "resize_target",
+                             raw=int(target), clamped=int(clamped))
+    return clamped
 
   def restart_barrier(self, name: str, count: int) -> None:
     """Rendezvous before a checkpoint-restart resize: guarantees the

@@ -200,11 +200,29 @@ class FaultInjector:
     caller must still apply."""
     fired: List[Fault] = []
     dropped = False
+    # Local import: this module stays importable standalone (pure
+    # stdlib; the hazard lint loads files by path), and the package
+    # import would pull jax. tracing itself is stdlib-only.
+    try:
+      from kf_benchmarks_tpu import tracing
+      trace = tracing.active()
+    except Exception:
+      trace = None
     for fault in self._faults:
       if fault.step != step or fault.index in self._fired:
         continue
       self._mark_fired(fault)
       fired.append(fault)
+      if trace is not None:
+        # Instant marker on the faults track BEFORE firing. The
+        # survivable kinds (heartbeat_delay / drop_msg / corrupt_ckpt)
+        # land in this rank's exported timeline; a kill/sigterm rank
+        # loses its in-memory spans (the trace exports at run end), so
+        # the durable record of those is the flight-recorder row the
+        # driver writes before this boundary fires (benchmark.py) --
+        # the recorder's continuous window hits disk every step.
+        trace.instant("faults", fault.describe(), step=step,
+                      kind=fault.kind)
       self._log(f"fault injected: {fault.describe()}")
       if fault.kind == "kill":
         import signal

@@ -139,11 +139,18 @@ def launch(np_: int, command: List[str], logdir: str = ".",
   rejoin, SURVEY 2.9, rendered as checkpointed restart). Bounded by
   ``max_restarts`` so a deterministic crash loop still terminates."""
   from kf_benchmarks_tpu.parallel import coordination
+  from kf_benchmarks_tpu import tracing
 
   server = coordination.CoordinatorServer(port=base_port)
   try:
     gen_np = np_
     opened_logs: set = set()
+    # One run id for the whole job (all ranks, all restart
+    # generations): workers inherit it via env, so their flight
+    # recorders and run traces share one timeline identity and the
+    # rank-0 trace merge is coherent (tracing.py).
+    extra_env = dict(extra_env or {})
+    extra_env.setdefault("KF_RUN_ID", tracing.resolve_run_id())
     for _ in range(max_restarts + 1):
       code, restart = _run_generation(server, gen_np, command, logdir,
                                       host, extra_env,

@@ -393,6 +393,22 @@ flags.DEFINE_integer("max_ckpts_to_keep", 5,
 flags.DEFINE_string("trace_file", None,
                     "Profiler trace output path (ref :270-275; jax.profiler "
                     "trace dir on TPU).")
+flags.DEFINE_string("trace_events_file", None,
+                    "Whole-run host-side span timeline (tracing.py; the "
+                    "run-wide successor of the reference's one-step "
+                    "timeline, ref :806-817): DeviceFeeder fetches/waits, "
+                    "dispatch issue + per-chunk device completion, "
+                    "compile episodes, checkpoint save/restore, eval, "
+                    "elastic reseams and fault injections, exported as "
+                    "Chrome trace-event JSON (loads in Perfetto / "
+                    "chrome://tracing; pid=rank, tid=subsystem; "
+                    "--use_chrome_trace_format=false writes the raw span "
+                    "JSONL instead). Host-only: the step program and "
+                    "per-step losses are bit-identical trace-on vs off "
+                    "(auditor twin rule). Per-rank files under kfrun, "
+                    "rank 0 merges at exit. Independent of the "
+                    "jax.profiler --trace_file device capture. Training "
+                    "runs only (validation.py).")
 flags.DEFINE_string("tfprof_file", None,
                     "Per-op profile output (ref tfprof_file :276-289; "
                     "compiled-HLO cost analysis dump on TPU).")
@@ -505,8 +521,12 @@ flags.DEFINE_boolean("freeze_when_forward_only", False,
 flags.DEFINE_integer("trt_max_workspace_size_bytes", 4 << 30,
                      "No-op on TPU (TensorRT knob, ref :619-620).")
 flags.DEFINE_boolean("use_chrome_trace_format", True,
-                     "No-op: jax.profiler writes its own trace format "
-                     "(ref :271-275).")
+                     "Export --trace_events_file as Chrome trace-event "
+                     "JSON (the reference's timeline.Timeline toggle, "
+                     "ref :271-275, wired to the run-trace exporter in "
+                     "tracing.py); false writes the raw span records as "
+                     "JSONL instead. The jax.profiler --trace_file "
+                     "capture is unaffected (it writes its own format).")
 flags.DEFINE_boolean("xla", False,
                      "No-op: XLA is the only execution path on TPU "
                      "(ref :413).")

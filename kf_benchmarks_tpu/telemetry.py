@@ -286,8 +286,12 @@ class FlightRecorder:
 
   def __init__(self, path: Optional[str] = None, window: int = 64,
                sigma: float = 6.0, rank: int = 0, log_fn=None,
-               min_history: int = 8):
+               min_history: int = 8, run_id: Optional[str] = None):
     self.path = path
+    # Shared with the run trace (tracing.py resolve_run_id): one run id
+    # across recorder rows and trace events, so a post-mortem window
+    # can be laid over the span timeline it belongs to.
+    self.run_id = run_id
     self.dump_path = (os.path.join(os.path.dirname(path),
                                    "flight_recorder.dump.jsonl")
                       if path else None)
@@ -321,13 +325,30 @@ class FlightRecorder:
 
   # -- recording ------------------------------------------------------------
 
+  def _stamp(self, rec: Dict[str, Any]) -> Dict[str, Any]:
+    """Wall + MONOTONIC timestamps (and the shared run id) on every
+    row: the wall clock anchors the row in operator time, the
+    monotonic one lays it over the run-trace timeline (tracing.py uses
+    the same clock for spans), immune to wall-clock steps mid-run."""
+    rec["t_wall"] = round(time.time(), 3)
+    rec["t_mono"] = round(time.monotonic(), 6)
+    if self.run_id:
+      rec["run_id"] = self.run_id
+    return rec
+
   def record(self, step: int, loss: Optional[float] = None, lr=None,
              health=None, wall_ms: Optional[float] = None,
-             chunk_len: int = 1, rtt_ms: Optional[float] = None) -> dict:
+             chunk_len: int = 1, rtt_ms: Optional[float] = None,
+             span_id: Optional[int] = None) -> dict:
     """Append one per-step record; detect anomalies against the
     TRAILING window (the current record is judged, not self-judged);
-    rewrite the continuous window file."""
-    rec: Dict[str, Any] = {"step": int(step), "rank": self.rank}
+    rewrite the continuous window file. ``span_id`` cross-links the
+    enclosing run-trace span (the dispatch this step resolved in), so
+    a post-mortem dump can be laid over the exported timeline."""
+    rec: Dict[str, Any] = self._stamp({"step": int(step),
+                                       "rank": self.rank})
+    if span_id:
+      rec["span_id"] = int(span_id)
     if loss is not None:
       rec["loss"] = float(loss)
     if lr is not None:
@@ -429,7 +450,7 @@ class FlightRecorder:
     preemption must show WHAT the run was doing, not just its losses.
     Events bypass anomaly detection (they are operator actions, not
     training signals)."""
-    rec = {"rank": self.rank}
+    rec = self._stamp({"rank": self.rank})
     rec.update(event)
     self._records.append(rec)
     self._write_window()
@@ -653,7 +674,8 @@ class TelemetrySession:
 
   @classmethod
   def create(cls, params, rank: int = 0, log_fn=None,
-             num_ranks: int = 1) -> Optional["TelemetrySession"]:
+             num_ranks: int = 1,
+             run_id: Optional[str] = None) -> Optional["TelemetrySession"]:
     """None unless the run's resolved --health_stats is on (benchmark
     resolves auto -> bool before building the step) -- OR the run is
     elastic/fault-injected with a train_dir sink: a preemption must
@@ -667,10 +689,11 @@ class TelemetrySession:
          bool(getattr(params, "fault_schedule", None))))
     if not wants:
       return None
-    return cls(params, rank=rank, log_fn=log_fn, num_ranks=num_ranks)
+    return cls(params, rank=rank, log_fn=log_fn, num_ranks=num_ranks,
+               run_id=run_id)
 
   def __init__(self, params, rank: int = 0, log_fn=None,
-               num_ranks: int = 1):
+               num_ranks: int = 1, run_id: Optional[str] = None):
     self.train_dir = getattr(params, "train_dir", None)
     self.rank = int(rank)
     self.num_ranks = max(1, int(num_ranks))
@@ -679,7 +702,7 @@ class TelemetrySession:
         window=int(getattr(params, "flight_recorder_window", None) or 64),
         sigma=float(getattr(params, "health_grad_norm_sigma", None)
                     or 6.0),
-        rank=self.rank, log_fn=log_fn)
+        rank=self.rank, log_fn=log_fn, run_id=run_id)
     self.recorder.install_signal_handlers()
     self.watchdog = StallWatchdog(
         factor=float(getattr(params, "stall_watchdog_factor", None)

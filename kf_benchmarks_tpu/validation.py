@@ -70,7 +70,13 @@ NO_CROSS_FLAG_VALIDATION = {
     "save_summaries_steps": "summary cadence only",
     "summary_verbosity": "summary tier selector (observability.py caps)",
     "loss_type_to_report": "display column selector",
-    "use_chrome_trace_format": "trace file format toggle",
+    "use_chrome_trace_format": "output-format toggle of the "
+                               "--trace_events_file exporter (tracing.py:"
+                               " Chrome trace-event JSON when true, raw "
+                               "span JSONL when false); reference CLIs "
+                               "also pass it with --trace_file, where it "
+                               "stays inert (jax.profiler owns that "
+                               "format), so no hard cross-check",
     "max_ckpts_to_keep": "checkpoint GC depth",
     "tf_random_seed": "seed value; any int is valid",
     "num_warmup_batches": "None = runtime default (benchmark.py:_run)",
@@ -572,6 +578,18 @@ def validate_cross_flags(params) -> None:
                      "serving export and requires --forward_only with "
                      "--aot_save_path (the TRT conversion analog, ref "
                      ":615-620, :2466-2486)")
+  if getattr(p, "trace_events_file", None) and (p.eval or p.forward_only):
+    # The span timeline instruments the TRAINING loop's wall-clock
+    # boundaries (feed, dispatch, compile, checkpoint, elastic seams);
+    # the eval/forward-only drivers carry none of them, and silently
+    # accepting the flag there would log success while tracing nothing
+    # (the round-1 ineffective-flag defect class).
+    raise ParamError(
+        "--trace_events_file instruments training runs only (the span "
+        "timeline covers the train loop's feed/dispatch/compile/"
+        "checkpoint/elastic boundaries, tracing.py); it cannot be "
+        "combined with --eval or --forward_only. The jax.profiler "
+        "--trace_file capture works in every mode")
   if p.aot_load_path and not p.forward_only:
     raise ParamError("--aot_load_path requires --forward_only (the "
                      "frozen artifact has no training program; ref: "

@@ -51,6 +51,7 @@ DISTRIBUTED_TESTS = [
     "tests/test_elastic_restart.py",
     "tests/test_kfrun.py",
     "tests/test_kill_rejoin.py",
+    "tests/test_trace_merge.py",
 ]
 
 # Long-running suites excluded from the fast default (whole-zoo model

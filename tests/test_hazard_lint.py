@@ -270,6 +270,53 @@ def test_step_line_literal_allowed_in_log(tmp_path):
   assert not _rules(tmp_path, "step-line-format")
 
 
+# -- trace-event-emission -----------------------------------------------------
+
+def test_trace_event_dict_outside_home_seeded(tmp_path):
+  _seed(tmp_path, "kf_benchmarks_tpu/rogue_trace.py",
+        "def emit(name, ts):\n"
+        "  return {'ph': 'X', 'name': name, 'ts': ts, 'dur': 1}\n")
+  violations = _rules(tmp_path, "trace-event-emission")
+  assert [v.path for v in violations] == \
+      ["kf_benchmarks_tpu/rogue_trace.py"]
+  assert "tracing.py" in violations[0].message
+  assert lint.main(["--root", str(tmp_path),
+                    "--rules", "trace-event-emission"]) == 1
+
+
+def test_trace_helper_def_outside_home_seeded(tmp_path):
+  _seed(tmp_path, "kf_benchmarks_tpu/rogue_stats.py",
+        "def percentile(values, q):\n  return sorted(values)[0]\n")
+  violations = _rules(tmp_path, "trace-event-emission")
+  assert len(violations) == 1 and "percentile" in violations[0].message
+
+
+def test_trace_emission_allowed_in_home_and_reads_clean(tmp_path):
+  # The home constructs events; other modules READ profiler output
+  # (observability.py's load_trace_op_events pattern) -- only
+  # construction is emission.
+  _seed(tmp_path, "kf_benchmarks_tpu/tracing.py",
+        "def chrome_events(spans):\n"
+        "  return [{'ph': 'X', 'name': s} for s in spans]\n")
+  _seed(tmp_path, "kf_benchmarks_tpu/reader.py",
+        "import json\n\n"
+        "def op_events(path):\n"
+        "  data = json.load(open(path))\n"
+        "  return [e for e in data.get('traceEvents', [])\n"
+        "          if e.get('ph') == 'X']\n")
+  _seed(tmp_path, "tests/test_free.py",
+        "EVENT = {'ph': 'X', 'name': 'tests may build fixtures'}\n")
+  assert not _rules(tmp_path, "trace-event-emission")
+
+
+def test_trace_emission_allowlist_staleness(tmp_path, monkeypatch):
+  _seed(tmp_path, "kf_benchmarks_tpu/clean.py", "x = 1\n")
+  monkeypatch.setattr(lint, "TRACE_EMISSION_ALLOWLIST",
+                      {"kf_benchmarks_tpu/clean.py": "legacy emitter"})
+  violations = _rules(tmp_path, "trace-event-emission")
+  assert len(violations) == 1 and "stale" in violations[0].message
+
+
 # -- flag-validation ----------------------------------------------------------
 
 PARAMS = ("from kf_benchmarks_tpu import flags\n\n"

@@ -248,6 +248,15 @@ MUTATIONS = [
     ("packed_btv_aux_lost", "lm_packed",
      lambda c: c.aux.pop("btv_bytes"),
      "packed-no-overhead"),
+    # PR 9 seed. A device-side reduction smuggled into the traced step
+    # is the exact regression the host-only tracing contract rules
+    # out: the trace-on fingerprint stops matching the trace-off twin.
+    # (A top-level full-mesh-group-free f32 all-reduce trips no other
+    # rule on the replicated base program, so exactly the twin rule
+    # fires.)
+    ("traced_device_side_reduction", "traced",
+     lambda c: _add_collective(c),
+     "trace-twin"),
 ]
 
 

@@ -1,0 +1,519 @@
+"""Unified run tracing (kf_benchmarks_tpu/tracing.py).
+
+Reference-style layering (SURVEY 7.1):
+  * pure-unit: spans / percentiles / compile ledger under an INJECTED
+    deterministic clock (no wall-clock flakiness anywhere in this
+    layer), Chrome trace-event schema validation, rank-file merge.
+  * log-scraping e2e: BenchmarkCNN.run() with ``--trace_events_file``
+    -- the emitted JSON validates against the trace-event schema
+    check, the percentile + compile-ledger lines are whole lines that
+    never interleave inside step lines (the test_benchmark.py scrape
+    guard), and the flight-recorder rows cross-link span ids and share
+    the run id.
+  * equivalence: per-step f32 losses and trained params BIT-identical
+    trace-on vs trace-off, through --steps_per_dispatch /
+    --num_grad_accum / --shard_optimizer_state (the host-only
+    contract; the program-shape half is the auditor's twin rule).
+"""
+
+import json
+import os
+import re
+
+import numpy as np
+import pytest
+
+import jax
+
+from kf_benchmarks_tpu import params as params_lib
+from kf_benchmarks_tpu import tracing
+from kf_benchmarks_tpu import validation
+from kf_benchmarks_tpu.analysis import baseline
+
+from tests.test_benchmark import STEP_RE, TOTAL_RE, _run_and_scrape
+
+
+class FakeClock:
+  """Injected monotonic clock: tests advance it explicitly."""
+
+  def __init__(self, t: float = 100.0):
+    self.t = t
+
+  def __call__(self) -> float:
+    return self.t
+
+  def tick(self, dt: float) -> float:
+    self.t += dt
+    return self.t
+
+
+def _trace(tmp_path=None, name="trace.json", **kw):
+  clock = FakeClock()
+  kw.setdefault("time_fn", clock)
+  kw.setdefault("wall_fn", lambda: 1_000.0)
+  path = str(tmp_path / name) if tmp_path is not None else None
+  return tracing.RunTrace(path=path, **kw), clock
+
+
+# -- percentiles --------------------------------------------------------------
+
+def test_percentile_math():
+  assert tracing.percentile([], 50) is None
+  assert tracing.percentile([7.0], 99) == 7.0
+  assert tracing.percentile([1, 2, 3, 4], 50) == 2.5
+  assert tracing.percentile([4, 3, 2, 1], 50) == 2.5  # order-free
+  assert abs(tracing.percentile([1, 2, 3, 4], 90) - 3.7) < 1e-12
+  assert tracing.percentile(range(1, 101), 99) == 99.01 or \
+      abs(tracing.percentile(range(1, 101), 99) - 99.01) < 1e-9
+
+
+def test_samples_to_fields_and_lines():
+  tr, _ = _trace()
+  for v in (0.010, 0.020, 0.030, 0.040):
+    tr.add_sample("chunk_wall", v)
+  tr.add_sample("feed_wait", 0.005)
+  fields = tr.percentile_fields()
+  assert fields["chunk_wall_p50"] == 0.025
+  assert fields["feed_wait_p99"] == 0.005
+  lines = tr.latency_lines()
+  assert all(l.startswith("latency percentiles: ") for l in lines)
+  assert any(re.fullmatch(
+      r"latency percentiles: chunk_wall p50=25\.000ms p90=[\d.]+ms "
+      r"p99=[\d.]+ms \(n=4\)", l) for l in lines), lines
+  # The scrape-guard contract: no percentile line carries the step-line
+  # marker.
+  assert not any("images/sec" in l for l in lines)
+
+
+# -- spans + Chrome export ----------------------------------------------------
+
+def test_span_forms_and_chrome_schema(tmp_path):
+  tr, clock = _trace(tmp_path)
+  t0 = tr.now()
+  clock.tick(0.5)
+  sid = tr.add_span("dispatch", "train_step", t0, 0.5, {"step": 1})
+  with tr.span("checkpoint", "save", step=2) as args:
+    clock.tick(0.25)
+    args["extra"] = "yes"
+  iid = tr.instant("faults", "kill at step 10", step=10)
+  assert 0 < sid < iid
+  out = tr.export()
+  assert out == str(tmp_path / "trace.json")
+  obj = json.load(open(out))
+  assert tracing.validate_chrome_trace(obj) == []
+  events = obj["traceEvents"]
+  xs = [e for e in events if e["ph"] == "X"]
+  names = {e["name"] for e in xs}
+  assert {"train_step", "save"} <= names
+  # Monotonic -> epoch mapping: anchor wall 1000.0 s at mono 100.0 s,
+  # so t0=100.0 lands at exactly 1e9 us.
+  disp = next(e for e in xs if e["name"] == "train_step")
+  assert disp["ts"] == 1_000.0 * 1e6
+  assert disp["dur"] == 0.5 * 1e6
+  assert disp["args"]["span_id"] == sid
+  save = next(e for e in xs if e["name"] == "save")
+  assert save["dur"] == 0.25 * 1e6
+  assert save["args"]["extra"] == "yes"  # args mutated inside the span
+  inst = next(e for e in events if e["ph"] == "i")
+  assert inst["args"]["step"] == 10
+  # Metadata rows name the subsystem lanes actually used.
+  threads = {e["args"]["name"] for e in events
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+  assert {"dispatch", "checkpoint", "faults"} <= threads
+  assert obj["metadata"]["run_id"] == tr.run_id
+
+
+def test_validate_chrome_trace_rejects_malformed():
+  assert tracing.validate_chrome_trace([]) != []
+  assert tracing.validate_chrome_trace({}) != []
+  bad_ph = {"traceEvents": [{"ph": "Q", "name": "x", "pid": 0, "tid": 0}]}
+  assert any("ph" in p for p in tracing.validate_chrome_trace(bad_ph))
+  no_ts = {"traceEvents": [{"ph": "X", "name": "x", "pid": 0, "tid": 0,
+                            "dur": 1}]}
+  assert any("ts" in p for p in tracing.validate_chrome_trace(no_ts))
+
+
+def test_span_cap_counts_drops(tmp_path, monkeypatch):
+  monkeypatch.setattr(tracing.RunTrace, "MAX_SPANS", 2)
+  tr, clock = _trace(tmp_path)
+  for i in range(4):
+    tr.add_span("dispatch", f"s{i}", tr.now(), 0.1)
+  obj = json.load(open(tr.export()))
+  assert len([e for e in obj["traceEvents"] if e["ph"] == "X"]) == 2
+  assert obj["metadata"]["dropped_spans"] == 2
+
+
+def test_no_path_keeps_samples_but_not_spans():
+  tr, _ = _trace(None)
+  # Unretained spans return id 0 (falsy): a cross-link consumer (the
+  # flight recorder's span_id) must never reference a span absent from
+  # every exported timeline.
+  assert tr.add_span("dispatch", "s", tr.now(), 0.1) == 0
+  assert tr.instant("faults", "x") == 0
+  tr.add_sample("chunk_wall", 0.1)
+  assert tr.export() is None
+  assert tr.percentile_fields()["chunk_wall_p50"] == 0.1
+
+
+def test_dropped_spans_return_id_zero(monkeypatch):
+  monkeypatch.setattr(tracing.RunTrace, "MAX_SPANS", 1)
+  tr = tracing.RunTrace(path="/tmp/unused-trace.json",
+                        time_fn=FakeClock(), wall_fn=lambda: 1.0)
+  assert tr.add_span("dispatch", "kept", 0.0, 0.1) > 0
+  assert tr.add_span("dispatch", "dropped", 0.0, 0.1) == 0
+
+
+def test_sample_decimation_bounds_memory(monkeypatch):
+  monkeypatch.setattr(tracing.RunTrace, "MAX_SAMPLES", 8)
+  tr, _ = _trace(None)
+  for i in range(100):
+    tr.add_sample("feed_wait", float(i))
+  row = tr.percentiles()["feed_wait"]
+  assert row["n"] == 100  # true observation count survives decimation
+  assert len(tr._samples["feed_wait"]) < 8 * 2
+  # The strided subsample keeps the distribution's shape.
+  assert 30.0 <= row["p50"] <= 70.0
+
+
+def test_raw_jsonl_export_when_chrome_format_off(tmp_path):
+  tr, clock = _trace(tmp_path, chrome_format=False)
+  tr.add_span("dispatch", "train_step", tr.now(), 0.5)
+  lines = open(tr.export()).read().splitlines()
+  head = json.loads(lines[0])
+  assert head["run_id"] == tr.run_id and "anchor_wall" in head
+  spans = [json.loads(l) for l in lines[1:]]
+  assert [s["name"] for s in spans] == ["train_step"]
+
+
+# -- multi-rank merge ---------------------------------------------------------
+
+def test_rank_path_convention(tmp_path):
+  p = str(tmp_path / "t.json")
+  assert tracing.rank_path(p, 0) == p
+  assert tracing.rank_path(p, 2) == str(tmp_path / "t.rank2.json")
+
+
+def test_rank0_merge_produces_one_coherent_timeline(tmp_path):
+  path = str(tmp_path / "t.json")
+  run_id = "run-shared"
+  r1, c1 = _trace(tmp_path, name="t.json", rank=1, num_ranks=2,
+                  run_id=run_id)
+  r1.add_span("dispatch", "peer_step", r1.now(), 0.1)
+  assert r1.export() == tracing.rank_path(path, 1)
+  r0, c0 = _trace(tmp_path, name="t.json", rank=0, num_ranks=2,
+                  run_id=run_id)
+  r0.add_span("dispatch", "chief_step", r0.now(), 0.1)
+  assert r0.export(merge_wait_s=1.0) == path
+  obj = json.load(open(path))
+  assert tracing.validate_chrome_trace(obj) == []
+  pids = {e["pid"] for e in obj["traceEvents"] if e["ph"] == "X"}
+  assert pids == {0, 1}
+  assert obj["metadata"]["run_id"] == run_id
+
+
+def test_restart_generation_extends_same_run_id_file(tmp_path):
+  """A kfrun checkpoint-restart re-execs the same command with the
+  same KF_RUN_ID: the relaunched generation's export must EXTEND the
+  job's timeline, not truncate it; a FRESH run (different run id) at
+  the same path overwrites."""
+  path = str(tmp_path / "t.json")
+  gen0, _ = _trace(tmp_path, name="t.json", run_id="run-job")
+  gen0.add_span("dispatch", "gen0_step", gen0.now(), 0.1)
+  gen0.export()
+  gen1, _ = _trace(tmp_path, name="t.json", run_id="run-job")
+  gen1.add_span("dispatch", "gen1_step", gen1.now(), 0.1)
+  gen1.export()
+  names = {e["name"] for e in json.load(open(path))["traceEvents"]
+           if e["ph"] == "X"}
+  assert names == {"gen0_step", "gen1_step"}
+  fresh, _ = _trace(tmp_path, name="t.json", run_id="run-other")
+  fresh.add_span("dispatch", "fresh_step", fresh.now(), 0.1)
+  fresh.export()
+  names = {e["name"] for e in json.load(open(path))["traceEvents"]
+           if e["ph"] == "X"}
+  assert names == {"fresh_step"}
+  # Raw JSONL mode appends under the same run id too.
+  raw_path = str(tmp_path / "raw.json")
+  for gen in range(2):
+    tr, _ = _trace(tmp_path, name="raw.json", run_id="run-raw",
+                   chrome_format=False)
+    tr.add_span("dispatch", f"raw_gen{gen}", tr.now(), 0.1)
+    tr.export()
+  lines = open(raw_path).read().splitlines()
+  assert [json.loads(l)["name"] for l in lines[1:]] == \
+      ["raw_gen0", "raw_gen1"]
+
+
+def test_standalone_merge_rank_files(tmp_path):
+  path = str(tmp_path / "t.json")
+  for r in (0, 1):
+    tr, _ = _trace(tmp_path, name="t.json", rank=r, num_ranks=1)
+    tr.add_span("dispatch", f"rank{r}", tr.now(), 0.1)
+    tr.export()
+  assert tracing.merge_rank_files(path, 2) == path
+  obj = json.load(open(path))
+  assert {e["pid"] for e in obj["traceEvents"] if e["ph"] == "X"} == {0, 1}
+
+
+# -- compile ledger -----------------------------------------------------------
+
+def test_compile_ledger_totals_and_table(tmp_path):
+  tr, _ = _trace(tmp_path)
+  tr.note_compile("aaaa111122223333", "train_chunk", 12.0,
+                  model="resnet50")
+  tr.note_compile("bbbb111122223333", "eval_step", 0.5, model="resnet50")
+  ledger = tr.compile_ledger()
+  assert ledger["shapes"] == 2
+  assert ledger["total_compile_s"] == 12.5
+  lines = tr.ledger_lines()
+  assert lines[0] == ("compile ledger: 2 program shape(s), total "
+                      "compile 12.50 s")
+  assert all(l.startswith("compile ledger:") for l in lines)
+  assert any("aaaa111122223333" in l and "train_chunk" in l
+             for l in lines)
+  assert not any("images/sec" in l for l in lines)
+  # Each episode also lands on the compile lane of the timeline.
+  obj = json.load(open(tr.export()))
+  compile_spans = [e for e in obj["traceEvents"]
+                   if e["ph"] == "X" and e["cat"] == "compile"]
+  assert {e["name"] for e in compile_spans} == {"train_chunk",
+                                                "eval_step"}
+  assert compile_spans[0]["args"]["fingerprint"]
+
+
+def test_ledger_persists_and_merges_across_runs(tmp_path):
+  tr, _ = _trace()
+  tr.note_compile("k1", "train_step", 10.0, model="trivial")
+  path = tr.write_ledger(str(tmp_path))
+  assert path == str(tmp_path / "compile_ledger.json")
+  tr2, _ = _trace()
+  tr2.note_compile("k1", "train_step", 8.0, model="trivial")
+  tr2.note_compile("k2", "train_chunk", 3.0, model="trivial")
+  tr2.write_ledger(str(tmp_path))
+  data = json.load(open(path))
+  assert set(data["entries"]) == {"k1", "k2"}
+  k1 = data["entries"]["k1"]
+  assert k1["compiles"] == 2
+  assert k1["min_wall_s"] == 8.0 and k1["last_wall_s"] == 8.0
+  # A corrupt prior file starts fresh rather than crashing the run end.
+  with open(path, "w") as f:
+    f.write("{torn")
+  tr3, _ = _trace()
+  tr3.note_compile("k3", "train_step", 1.0)
+  tr3.write_ledger(str(tmp_path))
+  assert set(json.load(open(path))["entries"]) == {"k3"}
+
+
+def test_empty_ledger_writes_nothing(tmp_path):
+  tr, _ = _trace()
+  assert tr.write_ledger(str(tmp_path)) is None
+  assert not os.path.exists(tmp_path / "compile_ledger.json")
+
+
+# -- fingerprint keys ---------------------------------------------------------
+
+def test_config_fingerprint_key_identity_and_exclusions():
+  base = dict(model="trivial", batch_size=4, num_devices=8)
+  k = baseline.config_fingerprint_key(base)
+  assert re.fullmatch(r"[0-9a-f]{16}", k)
+  assert baseline.config_fingerprint_key(dict(base)) == k
+  # Host-side sinks/cadences do not fragment the key...
+  assert baseline.config_fingerprint_key(
+      dict(base, train_dir="/tmp/x", trace_events_file="/tmp/t.json",
+           display_every=7)) == k
+  # ...while program-shaping fields and the program name do.
+  assert baseline.config_fingerprint_key(dict(base, batch_size=8)) != k
+  assert baseline.config_fingerprint_key(base, "train_chunk") != k
+
+
+# -- active-session registry --------------------------------------------------
+
+def test_active_registry_and_null_sink():
+  assert tracing.active() is tracing.NULL_TRACE
+  # The null sink accepts the full emission + reporting surface.
+  tracing.active().add_span("feed", "wait", 0.0, 0.1)
+  tracing.active().add_sample("feed_wait", 0.1)
+  with tracing.active().span("checkpoint", "save"):
+    pass
+  assert tracing.active().latency_lines() == []
+  assert tracing.active().compile_ledger()["shapes"] == 0
+  tr, _ = _trace()
+  try:
+    assert tracing.activate(tr) is tr
+    assert tracing.active() is tr
+  finally:
+    tracing.deactivate()
+  assert tracing.active() is tracing.NULL_TRACE
+
+
+def test_resolve_run_id_prefers_env(monkeypatch):
+  monkeypatch.setenv("KF_RUN_ID", "run-fixed")
+  assert tracing.resolve_run_id() == "run-fixed"
+  monkeypatch.delenv("KF_RUN_ID")
+  a = tracing.resolve_run_id(wall_fn=lambda: 1.0)
+  assert a.startswith("run-") and a != "run-fixed"
+
+
+# -- DeviceFeeder feed lane ---------------------------------------------------
+
+def test_device_feeder_emits_feed_spans_and_wait_samples(tmp_path):
+  from kf_benchmarks_tpu.data import device_feed
+  from kf_benchmarks_tpu.parallel import mesh as mesh_lib
+
+  def produce():
+    for i in range(3):
+      yield np.full((2, 2), i, np.float32), np.zeros((2,), np.int32)
+
+  tr = tracing.RunTrace(path=str(tmp_path / "t.json"))
+  tracing.activate(tr)
+  try:
+    mesh = mesh_lib.build_mesh(1, "cpu")
+    f = device_feed.DeviceFeeder(produce(), mesh_lib.batch_sharding(mesh),
+                                 prefetch=2)
+    try:
+      for _ in range(3):
+        next(f)
+    finally:
+      f.stop()
+  finally:
+    tracing.deactivate()
+  assert tr.percentiles()["feed_wait"]["n"] == 3
+  obj = json.load(open(tr.export()))
+  feed = [e for e in obj["traceEvents"]
+          if e["ph"] == "X" and e["cat"] == "feed"]
+  names = {e["name"] for e in feed}
+  assert {"fetch", "h2d", "wait"} <= names
+
+
+# -- flag validation ----------------------------------------------------------
+
+@pytest.mark.parametrize("mode", ["eval", "forward_only"])
+def test_trace_events_file_is_training_only(mode):
+  p = params_lib.make_params(model="trivial", device="cpu",
+                             trace_events_file="/tmp/t.json",
+                             **{mode: True})
+  with pytest.raises(validation.ParamError, match="training runs only"):
+    validation.validate_cross_flags(p)
+
+
+# -- log-scraping e2e ---------------------------------------------------------
+
+def _schema_checked(path):
+  obj = json.load(open(path))
+  problems = tracing.validate_chrome_trace(obj)
+  assert problems == [], problems
+  return obj
+
+
+def test_e2e_trace_file_covers_the_run(tmp_path):
+  """Acceptance: one CLI-shaped run emits a schema-valid Chrome trace
+  covering dispatch/device/compile/checkpoint/eval spans, the
+  percentile + ledger lines are whole lines outside every step line,
+  and the flight-recorder rows cross-link span ids under the shared
+  run id."""
+  trace_path = str(tmp_path / "trace.json")
+  train_dir = str(tmp_path / "train")
+  logs, stats = _run_and_scrape(
+      num_batches=8, display_every=1, train_dir=train_dir,
+      save_model_steps=4, trace_events_file=trace_path,
+      eval_during_training_at_specified_steps=["5"])
+  obj = _schema_checked(trace_path)
+  xs = [e for e in obj["traceEvents"] if e["ph"] == "X"]
+  cats = {e["cat"] for e in xs}
+  assert {"run", "dispatch", "device", "compile", "checkpoint",
+          "eval"} <= cats, cats
+  assert obj["metadata"]["run_id"] == stats["run_id"]
+  # Scrape guard: every marker-carrying line is a step line or the
+  # closing total -- the new report lines never interleave inside them.
+  marker_lines = [l for l in logs if "images/sec:" in l]
+  assert all(STEP_RE.match(l) or TOTAL_RE.match(l) for l in marker_lines)
+  lat_lines = [l for l in logs if l.startswith("latency percentiles: ")]
+  assert any("chunk_wall" in l for l in lat_lines)
+  assert any("checkpoint_save" in l for l in lat_lines)
+  ledger_lines = [l for l in logs if l.startswith("compile ledger:")]
+  assert len(ledger_lines) >= 3  # header + column row + >= 1 entry
+  # Stats fields (what bench.py forwards).
+  lat = stats["latency_percentiles"]
+  assert lat["chunk_wall_p50"] > 0
+  assert stats["compile_ledger"]["shapes"] >= 2  # train + eval programs
+  assert stats["compile_ledger"]["total_compile_s"] > 0
+  # Ledger entries carry the auditor's fingerprint-key format.
+  for e in stats["compile_ledger"]["entries"]:
+    assert re.fullmatch(r"[0-9a-f]{16}", e["key"])
+  # Persisted ledger merged under train_dir.
+  data = json.load(open(os.path.join(train_dir, "compile_ledger.json")))
+  assert data["run_id"] == stats["run_id"]
+  assert len(data["entries"]) == stats["compile_ledger"]["shapes"]
+  # Flight recorder: every step row cross-links an enclosing span id
+  # and shares the run id; timestamps carry wall AND monotonic clocks.
+  rows = [json.loads(l)
+          for l in open(os.path.join(train_dir, "flight_recorder.jsonl"))]
+  step_rows = [r for r in rows if "step" in r and "loss" in r]
+  assert step_rows
+  span_ids = {e["args"].get("span_id") for e in xs}
+  for r in step_rows:
+    assert r["run_id"] == stats["run_id"]
+    assert r["t_mono"] > 0 and r["t_wall"] > 0
+    assert r["span_id"] in span_ids
+  # The cross-linked spans are the device-completion spans.
+  linked = [e for e in xs
+            if e["args"].get("span_id") in {r["span_id"]
+                                            for r in step_rows}]
+  assert {e["cat"] for e in linked} == {"device"}
+
+
+def test_e2e_raw_jsonl_when_chrome_format_off(tmp_path):
+  trace_path = str(tmp_path / "trace.json")
+  logs, stats = _run_and_scrape(num_batches=4,
+                                trace_events_file=trace_path,
+                                use_chrome_trace_format=False)
+  lines = open(trace_path).read().splitlines()
+  head = json.loads(lines[0])
+  assert head["run_id"] == stats["run_id"]
+  names = {json.loads(l)["name"] for l in lines[1:]}
+  assert "train_step" in names
+
+
+def test_trace_off_still_reports_percentiles_and_ledger(tmp_path):
+  """The flag gates the FILE, not the aggregates: bench.py's JSON
+  fields ride every run."""
+  logs, stats = _run_and_scrape(num_batches=4)
+  assert stats["latency_percentiles"]["chunk_wall_p50"] > 0
+  assert stats["compile_ledger"]["shapes"] == 1
+  assert not (tmp_path / "trace.json").exists()
+  # No percentile line interleaves inside step lines here either.
+  marker_lines = [l for l in logs if "images/sec:" in l]
+  assert all(STEP_RE.match(l) or TOTAL_RE.match(l) for l in marker_lines)
+
+
+# -- equivalence: trace-on vs off ---------------------------------------------
+
+# The compositions compile two full step programs apiece: slow-tiered
+# (CLAUDE.md 60 s rule); [plain] stays tier-1 as the regression pin.
+@pytest.mark.parametrize("extra", [
+    {},
+    pytest.param({"steps_per_dispatch": 4}, marks=pytest.mark.slow),
+    pytest.param({"num_grad_accum": 2}, marks=pytest.mark.slow),
+    pytest.param({"shard_optimizer_state": True, "optimizer": "momentum"},
+                 marks=pytest.mark.slow),
+], ids=["plain", "K4", "accum2", "sharded"])
+def test_trace_on_bit_identical_to_off(tmp_path, extra):
+  """Acceptance: tracing is a pure host-side observer -- per-step
+  losses AND trained params bit-identical with --trace_events_file on
+  vs off, on the 8-device mesh, through the chunked / microbatched /
+  sharded compositions (the auditor's twin rule pins the program-shape
+  half of the same contract)."""
+  on_logs, on = _run_and_scrape(
+      num_devices=8, display_every=1,
+      trace_events_file=str(tmp_path / "t.json"), **extra)
+  off_logs, off = _run_and_scrape(num_devices=8, display_every=1,
+                                  **extra)
+  st_on = [(m.group(1), m.group(5)) for l in on_logs
+           if (m := STEP_RE.match(l))]
+  st_off = [(m.group(1), m.group(5)) for l in off_logs
+            if (m := STEP_RE.match(l))]
+  assert len(st_on) == 8 and st_on == st_off, (st_on, st_off)
+  for a, b in zip(jax.tree.leaves(on["state"].params),
+                  jax.tree.leaves(off["state"].params)):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+  _schema_checked(str(tmp_path / "t.json"))
