@@ -128,6 +128,11 @@ def main():
       # actually executed on.
       "mesh_shape": stats.get("mesh_shape"),
       "opt_state_bytes_per_device": stats.get("opt_state_bytes_per_device"),
+      # Per-device parameter HBM next to the optimizer-state field:
+      # the pair A/Bs --shard_params (FSDP, ~|params|/n expected)
+      # against replicated-param runs (~|params|). _CPU_FALLBACK
+      # semantics unchanged: describes whatever run actually executed.
+      "param_bytes_per_device": stats.get("param_bytes_per_device"),
       # Input-pipeline health (PR 8): fraction of the loop wall spent
       # blocked on the host feed. None here -- the resnet bench runs
       # the resident synthetic batch, which has no feeder -- but the

@@ -95,6 +95,11 @@ def rule_overlap_in_backward(contract, tracer):
       # (one owner per seeded violation, so mutation self-tests can
       # assert exactly one rule fires).
       return []
+    if _cfg(contract, "shard_params", False):
+      # Full FSDP's per-block gathers/scatters live inside the scan
+      # body by DESIGN; rule_fsdp_residency owns that program shape
+      # (one owner per seeded violation).
+      return []
     if in_loop:
       return [f"{len(in_loop)} collective(s) inside a scanned body with "
               "the in-backward hooks off -- a collective leaked into a "
@@ -281,6 +286,9 @@ def rule_sharded_opt_bytes(contract, tracer):
   # the replicated twin must drop the mesh too -- the comparison is
   # against the same device count's 1-D replicated state either way.
   twin_cfg.pop("mesh_shape", None)
+  # ... and --shard_params requires --shard_optimizer_state, so the
+  # replicated twin drops it with the rest.
+  twin_cfg.pop("shard_params", None)
   twin = tracer(twin_cfg, contract.program)
   full = twin.aux.get("opt_state_bytes_per_device")
   if full is None:
@@ -291,6 +299,70 @@ def rule_sharded_opt_bytes(contract, tracer):
             f"ZeRO bound ~|state|/n = {full}/{n} B (+pad slack "
             f"{bound} B) -- state is leaking back to replicated"]
   return []
+
+
+def _fsdp(contract) -> bool:
+  return bool(_cfg(contract, "shard_params", False))
+
+
+def _collective_bytes(c) -> int:
+  from kf_benchmarks_tpu.analysis import contracts as contracts_lib
+  return int(c.elems) * contracts_lib._ITEMSIZE.get(c.dtype, 4)
+
+
+def rule_fsdp_residency(contract, tracer):
+  """PR 10 (round 15): a --shard_params step never materializes the
+  full parameter tree.
+
+  Checks, against the traced aux (contracts.py): (a) scanned FSDP
+  models carry their per-block all-gather INSIDE the scan while body;
+  (b) the out-of-loop all-gather inventory never exceeds the planned
+  step-bucket count -- a whole-tree re-assembly (the round-11 trailing
+  gather) would show up as extra gathers here; (c) no single
+  all-gather result reaches half the full parameter-tree bytes --
+  every live re-assembled param buffer is bucket/block-sized. Under
+  --num_grad_accum the in-compute gathers disengage by design (one
+  whole-tree gather per step, train_step.py), so only the size bound
+  binds there."""
+  if not _fsdp(contract) or contract.program != "train_step":
+    return []
+  out = []
+  full_bytes = contract.aux.get("fsdp_param_full_bytes")
+  ags = [c for c in contract.collectives
+         if c.kind == "all-gather" and not c.scalar]
+  in_loop = [c for c in ags if c.in_loop]
+  out_loop = [c for c in ags if not c.in_loop]
+  if contract.aux.get("fsdp_engaged", True):
+    if contract.aux.get("fsdp_scan_prefixes") and not in_loop:
+      out.append(
+          "scanned FSDP model but no all-gather inside a scan while "
+          "body -- the per-block parameter gather left the loop (full "
+          "stack residency)")
+    planned = contract.aux.get("fsdp_step_gathers")
+    if planned is not None and len(out_loop) > planned:
+      out.append(
+          f"{len(out_loop)} all-gather(s) outside the scan bodies vs "
+          f"{planned} planned step gather bucket(s) -- a full-tree "
+          "re-assembly (the round-11 trailing gather) leaked back into "
+          "the steady state")
+  if full_bytes:
+    # Per-gather residency bound: half the full tree, floored at the
+    # largest PLANNED bucket result (a tree dominated by one layer --
+    # trivial's 1001-way head -- legitimately gathers most of its
+    # bytes in that layer's bucket; what must never appear is a gather
+    # larger than any planned bucket, i.e. a whole-tree re-assembly).
+    planned_max = contract.aux.get("fsdp_max_gather_bytes") or 0
+    bound = max(full_bytes // 2, planned_max + 1)
+    for where, group in (("in-loop", in_loop), ("step-level", out_loop)):
+      big = [c for c in group if _collective_bytes(c) >= bound]
+      if big:
+        out.append(
+            f"{len(big)} {where} all-gather(s) re-assemble "
+            f"{_collective_bytes(big[0])} B >= the residency bound "
+            f"{bound} B (full tree {full_bytes} B, largest planned "
+            f"bucket {planned_max} B) -- params leaked back to "
+            "replicated residency")
+  return out
 
 
 def rule_packed_no_overhead(contract, tracer):
@@ -468,6 +540,7 @@ RULES: Dict[str, Callable] = {
     "wire-dtype": rule_wire_dtype,
     "sharded-collectives": rule_sharded_collectives,
     "sharded-opt-bytes": rule_sharded_opt_bytes,
+    "fsdp-residency": rule_fsdp_residency,
     "packed-no-overhead": rule_packed_no_overhead,
     "no-host-transfer": rule_no_host_transfer,
     "state-donated": rule_state_donated,

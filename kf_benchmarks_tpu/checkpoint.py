@@ -69,7 +69,8 @@ def _replica0_local(x):
 
 
 def savable_state(state, sharded_opt_state: bool = False,
-                  input_incarnation: int = 0) -> dict:
+                  input_incarnation: int = 0,
+                  sharded_params: bool = False) -> dict:
   """Host-side, mode-invariant snapshot: replica-0 slice of the stacked
   arrays + replicated scalars (ref: variable_mgr savable_variables).
 
@@ -81,11 +82,19 @@ def savable_state(state, sharded_opt_state: bool = False,
   broadcasts. Model variables (params/batch_stats) stay v0-sliced and
   mode-invariant, so eval / restore_opt_state=False interop across
   modes is preserved; validation.py keeps sharded runs single-process,
-  which is what makes every row chief-addressable here."""
+  which is what makes every row chief-addressable here.
+
+  ``sharded_params=True`` (--shard_params): the PARAMS rows are shards
+  too (the FSDP steady state), so they follow the same full-stack rule
+  and the snapshot carries ``params_layout`` -- an FSDP checkpoint is
+  NOT v0-readable and only resumes --shard_params runs (restore_state
+  rejects cross-layout restores loudly; batch_stats stay v0-sliced,
+  they never shard)."""
   slice0 = lambda t: jax.tree.map(_replica0_local, t)
   snap = {
       "step": int(state.step),
-      "params": slice0(state.params),
+      "params": (jax.tree.map(np.asarray, state.params)
+                 if sharded_params else slice0(state.params)),
       "opt_state": (jax.tree.map(np.asarray, state.opt_state)
                     if sharded_opt_state else slice0(state.opt_state)),
       "batch_stats": slice0(state.batch_stats),
@@ -94,6 +103,8 @@ def savable_state(state, sharded_opt_state: bool = False,
   }
   if sharded_opt_state:
     snap["opt_state_layout"] = "sharded"
+  if sharded_params:
+    snap["params_layout"] = "sharded"
   if input_incarnation:
     # The input-stream incarnation the RESUMED run must reopen at
     # (benchmark._open_input folds the data rng by it after elastic
@@ -105,7 +116,8 @@ def savable_state(state, sharded_opt_state: bool = False,
 
 def save_checkpoint(train_dir: str, state, max_to_keep: int = 5,
                     sharded_opt_state: bool = False,
-                    input_incarnation: int = 0) -> str:
+                    input_incarnation: int = 0,
+                    sharded_params: bool = False) -> str:
   """Write a checkpoint; prune beyond ``max_to_keep``
   (ref: --max_ckpts_to_keep, benchmark_cnn.py:606-608). No-op on
   non-chief processes."""
@@ -113,7 +125,8 @@ def save_checkpoint(train_dir: str, state, max_to_keep: int = 5,
     return ""
   os.makedirs(train_dir, exist_ok=True)
   snap = savable_state(state, sharded_opt_state=sharded_opt_state,
-                       input_incarnation=input_incarnation)
+                       input_incarnation=input_incarnation,
+                       sharded_params=sharded_params)
   step = snap["step"]
   fname = f"model.ckpt-{step}.msgpack"
   path = os.path.join(train_dir, fname)
@@ -248,7 +261,8 @@ def _reseed_staged(buffers, params):
 
 
 def restore_state(state, snapshot: dict, restore_opt_state: bool = True,
-                  sharded_opt_state: bool = False):
+                  sharded_opt_state: bool = False,
+                  sharded_params: bool = False):
   """Rebuild a stacked device TrainState from a host snapshot: replica-0
   values are broadcast to every replica (the restore-side analog of the
   reference's post-init v0->v* copy, variable_mgr.py:342-356).
@@ -279,7 +293,41 @@ def restore_state(state, snapshot: dict, restore_opt_state: bool = True,
         "--shard_optimizer_state checkpoints only resume sharded runs "
         "of the same topology (pass restore_opt_state=False to warm-"
         "start model variables only)")
-  params = _restack(state.params, snapshot["params"])
+  snap_fsdp = snapshot.get("params_layout") == "sharded"
+  if snap_fsdp != sharded_params:
+    if snap_fsdp and not sharded_params and not restore_opt_state:
+      # Model-variables-only restore (the EVAL path's semantic; the
+      # eval graph never trains, so validation's --shard_params
+      # training-only rule keeps eval runs replicated by
+      # construction): de-shard the saved stacks host-side against
+      # the live full-shape template -- the flat row-major addressing
+      # is exact (ops/sharded.py fsdp_stacked_shards), so an FSDP
+      # checkpoint stays eval-readable like every other layout.
+      full = _deshard_params(state.params, snapshot["params"])
+      return state.replace(
+          step=jnp.asarray(snapshot["step"], jnp.int32),
+          params=_restack(state.params, full),
+          batch_stats=_restack(state.batch_stats,
+                               snapshot["batch_stats"]),
+          loss_scale=jnp.asarray(snapshot["loss_scale"], jnp.float32),
+          loss_scale_normal_steps=jnp.asarray(
+              snapshot["loss_scale_normal_steps"], jnp.int32),
+          buffers=_reseed_staged(
+              state.buffers, _restack(state.params, full)))
+    # TRAIN resumes across layouts are rejected in both directions: an
+    # FSDP snapshot's rows are 1/n flat shards a replicated run would
+    # silently broadcast as whole tensors, and vice versa.
+    raise ValueError(
+        f"checkpoint params layout is "
+        f"{'sharded (FSDP)' if snap_fsdp else 'replicated'} but the "
+        f"run's is {'sharded (FSDP)' if sharded_params else 'replicated'}"
+        ": --shard_params checkpoints only resume --shard_params runs "
+        "(and vice versa) -- re-run with the matching flag (eval "
+        "restores, restore_opt_state=False, de-shard automatically)")
+  if sharded_params:
+    params = _reshard(state.params, snapshot["params"])
+  else:
+    params = _restack(state.params, snapshot["params"])
   if restore_opt_state:
     if snap_sharded:
       # Reshard cost on the run-trace checkpoint lane (tracing.py
@@ -400,13 +448,68 @@ def _reshard(template, host_tree):
       else:
         flat = np.pad(flat, (0, need - flat.size))
       return jnp.asarray(flat.reshape(tuple(t.shape)), t.dtype)
+    if h.ndim == 3 and t.ndim == 3 and h.shape[1] == t.shape[1]:
+      # FSDP scanned-stack leaves, (n, L, k) -> (n', L, k'): the same
+      # flat re-address applied PER LAYER (ops/sharded.py
+      # fsdp_stacked_shards stacks each layer's padded flat vector
+      # independently, so layer l's rows re-slice exactly like a 2-D
+      # (n, k) stack). Only zero pad is ever cut, as in the 2-D case.
+      n_layers = h.shape[1]
+      per_layer = np.moveaxis(h, 1, 0).reshape(n_layers, -1)
+      need = int(t.shape[0]) * int(t.shape[2])
+      if need <= per_layer.shape[1]:
+        per_layer = per_layer[:, :need]
+      else:
+        per_layer = np.pad(per_layer,
+                           ((0, 0), (0, need - per_layer.shape[1])))
+      out = np.moveaxis(
+          per_layer.reshape(n_layers, int(t.shape[0]), int(t.shape[2])),
+          0, 1)
+      return jnp.asarray(out, t.dtype)
     raise ValueError(
-        f"sharded opt_state leaf shape {h.shape} cannot be resliced "
-        f"onto live {tuple(t.shape)}: only stacked (n, k) shard rows "
-        "and (n,) per-shard scalars have a defined cross-topology "
-        "layout (ops/sharded.py)")
+        f"sharded state leaf shape {h.shape} cannot be resliced "
+        f"onto live {tuple(t.shape)}: only stacked (n, k) shard rows, "
+        "(n, L, k) per-layer stacks of the same depth, and (n,) "
+        "per-shard scalars have a defined cross-topology layout "
+        "(ops/sharded.py)")
 
   return jax.tree.map(place, template, host_state)
+
+
+def _deshard_params(template, host_tree):
+  """FSDP shard stacks (savable_state ``params_layout: sharded``) ->
+  full host param tree, re-assembled against the LIVE replicated
+  template's shapes (leaves ``(n_replicas, *full)``).
+
+  Pure host numpy: the flat row-major addressing of
+  ops/sharded.fsdp_stacked_shards is inverted exactly -- concat the
+  shard rows, drop the zero pad, restore the full shape (per layer for
+  the 3-D ``(n, L, k)`` scanned stacks). Serves the eval-side restore
+  (restore_opt_state=False) so FSDP checkpoints stay readable by
+  replicated-param consumers."""
+  host_state = serialization.from_state_dict(
+      jax.tree.map(np.asarray, template), host_tree)
+
+  def f(t, h):
+    h = np.asarray(h)
+    full_shape = tuple(t.shape)[1:]
+    size = (int(np.prod(full_shape, dtype=np.int64))
+            if full_shape else 1)
+    if h.ndim == 2:
+      return h.reshape(-1)[:size].reshape(full_shape)
+    if h.ndim == 3 and full_shape and h.shape[1] == full_shape[0]:
+      n_layers = h.shape[1]
+      per_layer = size // n_layers
+      return np.moveaxis(h, 1, 0).reshape(
+          n_layers, -1)[:, :per_layer].reshape(full_shape)
+    raise ValueError(
+        f"FSDP param leaf shape {h.shape} cannot de-shard onto the "
+        f"full shape {full_shape}: only (n, k) stacks and (n, L, k) "
+        "per-layer stacks of matching depth have a defined layout "
+        "(ops/sharded.py)")
+
+  return serialization.to_state_dict(
+      jax.tree.map(f, template, host_state))
 
 
 def _restack(template, host_tree):

@@ -151,6 +151,18 @@ class _TransformerLMModule(nn.Module):
   # Optional 16-bit wire dtype for the hook's collectives
   # (allreduce.compact_wire_dtype); None = the gradient's own dtype.
   grad_reduce_compact: Any = None
+  # --shard_params (full FSDP): per-block gather hook
+  # (ops/overlap.fsdp_block_gatherer). The 'blocks' stack is STORED as
+  # flat per-layer parameter shards ((L, k) locally; ops/sharded.py
+  # fsdp_stacked_shards); each nn.scan iteration re-assembles ONE
+  # block's full params with a packed all-gather INSIDE the scan body
+  # (under nn.remat, so the backward re-gathers during recompute), and
+  # the hook's custom_vjp backward reduce-scatters that block's
+  # cotangent in the same position -- the full layer stack never
+  # materializes. None = plain replicated-param storage. Exclusive
+  # with grad_reduce_axis (validation.py rejects --shard_params +
+  # --overlap_gradient_reduction upstream).
+  fsdp_block_hook: Any = None
   max_len: int = SEQ_LEN
   dtype: Any = jnp.float32
   param_dtype: Any = jnp.float32
@@ -193,7 +205,17 @@ class _TransformerLMModule(nn.Module):
       # scan-safe setting (the scan barrier already blocks the CSE
       # that prevent_cse guards against; True pessimizes TPU code).
       block_cls = _Block
-      if self.grad_reduce_axis is not None:
+      if self.fsdp_block_hook is not None:
+        # FSDP storage -> full block params, one packed all-gather per
+        # scan iteration (ops/overlap.py gather_params). Init stays
+        # full-shape and collective-free: the hook passes the empty
+        # pre-creation store through, so module.init creates FULL
+        # params under plain jit and the train step's init_state
+        # re-stacks them into the shard layout host-side.
+        block_cls = nn.map_variables(
+            _Block, "params", trans_in_fn=self.fsdp_block_hook,
+            init=True)
+      elif self.grad_reduce_axis is not None:
         # In-backward reduction hook (ops/overlap.py): the block's
         # per-layer param slice passes through an identity-with-
         # custom_vjp whose backward pmeans the slice's cotangent, so
@@ -304,12 +326,44 @@ class TransformerLMModel(model_lib.Model):
       grad_reduce_axis = REPLICA_AXIS
       grad_reduce_compact = allreduce.compact_wire_dtype(p)
       self.in_backward_reduced_prefixes = ("blocks",)
+    # --shard_params (full FSDP): the scanned 'blocks' stack stores as
+    # per-layer parameter shards and each scan iteration gathers ONE
+    # block inside the loop body (ops/overlap.fsdp_block_gatherer).
+    # fsdp_gathered_prefixes tells the step-level bucket gather
+    # (train_step.py) these leaves are module-gathered. Training module
+    # only: eval applies the PLAIN module to the step-gathered full
+    # tree. The loop fallback needs no hook -- its per-layer 'block_i'
+    # top keys are exactly the builder-layer buckets the step gathers.
+    fsdp_block_hook = None
+    if (phase_train and layers == "scan" and p is not None
+        and getattr(p, "shard_params", False)):
+      from kf_benchmarks_tpu.ops import overlap as overlap_lib
+      from kf_benchmarks_tpu.parallel.mesh import BATCH_AXIS, MODEL_AXIS
+      plain = _TransformerLMModule(dtype=dtype, param_dtype=param_dtype,
+                                   attn_impl=impl,
+                                   fused_head=head == "fused",
+                                   scan_layers=True)
+      sample = jnp.zeros(tuple(self.get_input_shapes("train")[0]),
+                         jnp.int32)
+      # Abstract init (nothing executes): one block's full shapes =
+      # the stacked 'blocks' leaves with the leading layer axis
+      # stripped -- the gather spec the hook re-assembles against.
+      variables = jax.eval_shape(
+          lambda: plain.init({"params": jax.random.PRNGKey(0),
+                              "dropout": jax.random.PRNGKey(0)}, sample))
+      block_template = jax.tree.map(
+          lambda s: jax.ShapeDtypeStruct(tuple(s.shape)[1:], s.dtype),
+          variables["params"]["blocks"])
+      fsdp_block_hook = overlap_lib.fsdp_block_gatherer(
+          block_template, BATCH_AXIS, MODEL_AXIS)
+      self.fsdp_gathered_prefixes = ("blocks",)
     return _TransformerLMModule(dtype=dtype, param_dtype=param_dtype,
                                 attn_impl=impl,
                                 fused_head=head == "fused",
                                 scan_layers=layers == "scan",
                                 grad_reduce_axis=grad_reduce_axis,
-                                grad_reduce_compact=grad_reduce_compact)
+                                grad_reduce_compact=grad_reduce_compact,
+                                fsdp_block_hook=fsdp_block_hook)
 
   def get_input_shapes(self, subset):
     n = self.get_batch_size()

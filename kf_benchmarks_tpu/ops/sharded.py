@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from kf_benchmarks_tpu.parallel.mesh import BATCH_AXIS, MODEL_AXIS
@@ -112,3 +113,144 @@ def gather_tree(shards, template, batch_axis: str = BATCH_AXIS,
     full = lax.all_gather(s, axes, tiled=True)
     return full[:t.size].reshape(t.shape).astype(t.dtype)
   return jax.tree.map(f, shards, template)
+
+
+# -- FSDP parameter layout (--shard_params) ----------------------------------
+#
+# The round-11 layout above, applied to the PARAMETER tree itself
+# (Rajbhandari et al. ZeRO-3 / the SNIPPETS.md [3] "shard W along the
+# model axis" pattern): params live as shards between steps, the step
+# re-assembles them per bucket / per scanned block INSIDE the
+# forward/backward (ops/overlap.py gather_params), and the optimizer
+# applies on the shard -- no full tree ever materializes, and the
+# round-11 trailing all-gather disappears from the steady state.
+#
+# Two leaf families, so the scanned transformer can gather ONE block at
+# a time:
+#
+# * non-scanned leaf (*s):        (n, k),    k = ceil(prod(s) / n)
+# * scanned-prefix leaf (L, *s):  (n, L, k), k = ceil(prod(s) / n)
+#   -- the (n, k) stacking applied PER LAYER, transposed so the shard
+#   row leads uniformly: the whole TrainState keeps one leading
+#   stacked-device dim (P over the combined mesh axes), and the
+#   nn.scan/lax.scan bodies slice layer l's local shard as row l of the
+#   squeezed (L, k) view.
+
+
+def top_level_key(path) -> str:
+  """Top-level pytree key of a jax key path (builder-layer / scanned-
+  stack granularity; the same convention as ops/overlap.py bucketing)."""
+  if not path:
+    return ""
+  p = path[0]
+  return str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", p))))
+
+
+def _leaf_map(tree, scanned_prefixes, f_plain, f_scanned):
+  def f(path, leaf):
+    if top_level_key(path) in scanned_prefixes:
+      return f_scanned(leaf)
+    return f_plain(leaf)
+  return jax.tree_util.tree_map_with_path(f, tree)
+
+
+def fsdp_stacked_shards(tree, num_shards: int, scanned_prefixes=()):
+  """Full param tree -> host-global FSDP shard stacks (see module
+  notes): sharding the leading dim over the combined mesh axes puts
+  exactly this device's flat shard (rows of every layer, for scanned
+  leaves) on each device."""
+  def plain(x):
+    flat, k = _pad_flat(x, num_shards)
+    return flat.reshape(num_shards, k)
+
+  def scanned(x):
+    if x.ndim < 1:
+      raise ValueError(
+          "scanned-prefix FSDP leaves need a leading layer axis; got a "
+          f"scalar leaf of shape {tuple(x.shape)}")
+    n_layers = x.shape[0]
+    size = int(x.size) // n_layers
+    k = shard_len(size, num_shards)
+    flat = x.reshape(n_layers, size)
+    flat = jnp.pad(flat, ((0, 0), (0, num_shards * k - size)))
+    # (L, n, k) -> (n, L, k): shard row leads, like every other leaf.
+    return jnp.moveaxis(flat.reshape(n_layers, num_shards, k), 1, 0)
+
+  return _leaf_map(tree, scanned_prefixes, plain, scanned)
+
+
+def fsdp_gather_full(local, template, scanned_prefixes=(),
+                     batch_axis: str = BATCH_AXIS,
+                     model_axis: str = MODEL_AXIS):
+  """Local FSDP shard tree (leaves (k,) / (L, k), i.e. the squeezed
+  per-device rows) -> the FULL tree, inside the shard_mapped body.
+
+  The whole-tree re-assembly: the eval step and the --num_grad_accum
+  path use it (the accumulated-gradient path keeps the full tree
+  resident for the microbatch scan, exactly like the round-11 steady
+  state -- the in-compute per-bucket gathers disengage there the same
+  way the overlap hooks do)."""
+  axes = (batch_axis, model_axis)
+
+  def plain(s, t):
+    full = lax.all_gather(s, axes, tiled=True)
+    return full[:t.size].reshape(t.shape).astype(t.dtype)
+
+  def scanned(s, t):
+    size = int(np.prod(t.shape[1:], dtype=np.int64)) if t.ndim > 1 else 1
+    full = lax.all_gather(s, axes, axis=1, tiled=True)  # (L, n*k)
+    return full[:, :size].reshape(t.shape).astype(t.dtype)
+
+  by_path = dict(jax.tree_util.tree_flatten_with_path(template)[0])
+
+  def f(path, s):
+    t = by_path[tuple(path)]
+    if top_level_key(path) in scanned_prefixes:
+      return scanned(s, t)
+    return plain(s, t)
+  return jax.tree_util.tree_map_with_path(f, local)
+
+
+def fsdp_scatter_mean(grads, scanned_prefixes=(),
+                      batch_axis: str = BATCH_AXIS,
+                      model_axis: str = MODEL_AXIS):
+  """Full local gradient tree -> this device's FSDP-layout mean shards
+  (the post-hoc scatter of the accumulated-gradient path).
+
+  Per element this is EXACTLY :func:`scatter_mean` -- the batch-axis
+  psum_scatter meets the same B contributions in the same group order,
+  then the free model sub-slice -- only the shard ADDRESSING differs
+  (per-layer rows for scanned leaves), so the elementwise optimizer
+  sees bit-identical values in either layout."""
+  nb = lax.axis_size(batch_axis)
+  nm = lax.axis_size(model_axis)
+  n = nb * nm
+  mi = lax.axis_index(model_axis)
+
+  def plain(x):
+    flat, k = _pad_flat(x, n)
+    block = lax.psum_scatter(flat, batch_axis, tiled=True) / nb
+    return lax.dynamic_slice(block, (mi * k,), (k,))
+
+  def scanned(x):
+    n_layers = x.shape[0]
+    size = int(x.size) // n_layers
+    k = shard_len(size, n)
+    flat = jnp.pad(x.reshape(n_layers, size),
+                   ((0, 0), (0, n * k - size)))
+    block = lax.psum_scatter(flat, batch_axis, scatter_dimension=1,
+                             tiled=True) / nb  # (L, nm * k)
+    return lax.dynamic_slice(block, (0, mi * k), (n_layers, k))
+
+  return _leaf_map(grads, scanned_prefixes, plain, scanned)
+
+
+def fsdp_param_bytes(template) -> int:
+  """Full-tree parameter bytes of a (possibly abstract) template --
+  the denominator of the residency contract (analysis/audit.py
+  rule_fsdp_residency)."""
+  total = 0
+  for leaf in jax.tree.leaves(template):
+    total += int(np.prod(leaf.shape, dtype=np.int64)) * jnp.dtype(
+        leaf.dtype).itemsize
+  return total

@@ -26,6 +26,7 @@ TPU rebuild treats as first-class.
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Any, Dict
 
@@ -161,6 +162,94 @@ def unstack_blocks(params):
   return out
 
 
+def fsdp_stack_blocks(stacked_params, n_shards: int):
+  """stack_blocks() tree -> FSDP storage: every 'blocks' leaf (L, *s)
+  becomes its per-layer flat shard stack (L, n, k), k = ceil(prod(s)/n)
+  -- the ops/sharded.py (n, k) layout applied per layer, sharded over
+  the combined (data, seq) axes by :func:`fsdp_param_specs` so each
+  device holds one (L, 1, k) slice. The scan body re-assembles ONE
+  layer per iteration (--shard_params's composed-trainer leg)."""
+  out = {k: v for k, v in stacked_params.items() if k != "blocks"}
+
+  def f(x):
+    n_layers = x.shape[0]
+    size = int(x.size) // n_layers
+    k = -(-size // n_shards)
+    flat = jnp.pad(x.reshape(n_layers, size),
+                   ((0, 0), (0, n_shards * k - size)))
+    return flat.reshape(n_layers, n_shards, k)
+
+  out["blocks"] = jax.tree.map(f, stacked_params["blocks"])
+  return out
+
+
+def fsdp_unstack_blocks(fsdp_params, block_template):
+  """Inverse of :func:`fsdp_stack_blocks` (host-side; tests compare the
+  trained FSDP state against the dense oracle's): (L, n, k) stacks
+  flatten back per layer, pad drops, full shapes restore from
+  ``block_template`` (the stacked blocks tree of the ORIGINAL
+  layout)."""
+  out = {k: v for k, v in fsdp_params.items() if k != "blocks"}
+
+  def f(x, t):
+    n_layers = x.shape[0]
+    size = int(math.prod(t.shape[1:]))
+    return jnp.asarray(x).reshape(n_layers, -1)[:, :size].reshape(
+        tuple(t.shape)).astype(t.dtype)
+
+  out["blocks"] = jax.tree.map(f, fsdp_params["blocks"], block_template)
+  return out
+
+
+def _fsdp_block_hook(block_template, axes):
+  """Per-iteration FSDP gather for the scanned composed trainer: sliced
+  per-layer flat shards (k,) -> the block's full param tree via one
+  packed tiled all-gather over ``axes`` (the combined (data, seq)
+  data-parallel axes); the custom_vjp backward reduce-scatters the
+  block's cotangent as one packed psum_scatter in the same loop
+  position -- the SUM the pre-summed gradient convention of
+  make_train_step expects (the /n_data divide happens outside, as for
+  every other leaf). Built on ops/overlap.py's shared packing
+  primitives (packed_gather_rows / pack_cotangent_rows /
+  split_shard_row) so the row addressing cannot drift from the
+  benchmark leg's gather_params; only the reduction differs: SUM over
+  the combined axes (one shard row per device) instead of
+  gather_params' batch-mean + model sub-slice. Works on vma and
+  pre-vma jax alike: the collectives are explicit, like
+  reduce_identity's pre-vma arm in _scan_grad_hook."""
+  from kf_benchmarks_tpu.ops import overlap as overlap_lib
+  t_leaves = jax.tree_util.tree_flatten(block_template)[0]
+  shapes = tuple(tuple(t.shape) for t in t_leaves)
+  dtypes = tuple(jnp.dtype(t.dtype).name for t in t_leaves)
+
+  @functools.partial(jax.custom_vjp, nondiff_argnums=())
+  def gather(shards):
+    return overlap_lib.packed_gather_rows(axes, shapes, dtypes, shards)
+
+  def fwd(shards):
+    return gather(shards), None
+
+  def bwd(_, cots):
+    n = math.prod(lax.axis_size(a) for a in axes)
+    mat, ks = overlap_lib.pack_cotangent_rows(cots, shapes, n,
+                                              jnp.float32)
+    # SUM over the data-parallel peers (matching the pre-summed
+    # gradients of the replicated leaves): the tiled scatter over the
+    # full n-device group hands each device exactly its own (1, K)
+    # shard row -- the transpose of the gather's concatenation order.
+    row = lax.psum_scatter(mat, axes, scatter_dimension=0,
+                           tiled=True)[0]
+    return (overlap_lib.split_shard_row(row, ks, dtypes),)
+
+  gather.defvjp(fwd, bwd)
+
+  def hook(lp):
+    leaves, treedef = jax.tree_util.tree_flatten(lp)
+    return jax.tree_util.tree_unflatten(treedef, list(gather(tuple(leaves))))
+
+  return hook
+
+
 def stacked_param_specs():
   """Specs for the stacked tree: a leading (replicated) layer axis on
   every block leaf; the tensor axis stays on the same dims as
@@ -173,6 +262,19 @@ def stacked_param_specs():
       "w2": P(None, TENSOR_AXIS, None), "b2": P(None),
   }
   return {"embed": P(), "pos": P(), "ln_f": P(), "blocks": blocks}
+
+
+def fsdp_param_specs(data_axis: str):
+  """Specs for an :func:`fsdp_stack_blocks` tree: every (L, n, k)
+  blocks leaf shards its shard-row dim over the combined (data, seq)
+  data-parallel axes (one row per device); non-block leaves keep the
+  stacked layout's replication."""
+  blocks_spec = P(None, (data_axis, SEQ_AXIS))
+  return {"embed": P(), "pos": P(), "ln_f": P(),
+          "blocks": {"ln1": blocks_spec, "ln2": blocks_spec,
+                     "wqkv": blocks_spec, "wo": blocks_spec,
+                     "w1": blocks_spec, "b1": blocks_spec,
+                     "w2": blocks_spec, "b2": blocks_spec}}
 
 
 def _scan_grad_hook(data_axes):
@@ -280,7 +382,7 @@ def forward_local(params, tokens, *, seq_axis=SEQ_AXIS,
                   tensor_axis=TENSOR_AXIS, expert_axis=REPLICA_AXIS,
                   moe_capacity=None, sp_layout: str = "contiguous",
                   attn_inner_block=None, remat_policy=None,
-                  grad_reduce_axes=None):
+                  grad_reduce_axes=None, fsdp_gather_hook=None):
   """Per-shard forward: tokens (B_local, T_local) -> (logits, moe_aux).
 
   Runs inside a shard_map body; params are the LOCAL shards
@@ -315,6 +417,14 @@ def forward_local(params, tokens, *, seq_axis=SEQ_AXIS,
                   if grad_reduce_axes else None)
 
     def one_block(xm, lp):
+      if fsdp_gather_hook is not None:
+        # --shard_params's composed-trainer leg: lp arrives as flat
+        # per-layer shards; ONE packed all-gather re-assembles this
+        # block INSIDE the scan body (under the jax.checkpoint below,
+        # so the backward re-gathers during recompute) and the hook's
+        # backward reduce-scatters the block's cotangent in the same
+        # position (_fsdp_block_hook).
+        lp = fsdp_gather_hook(lp)
       if block_hook is not None:
         lp = block_hook(lp)
       xm, h = _attention_residual(lp, xm, seq_axis=seq_axis,
@@ -493,7 +603,8 @@ def make_train_step(mesh: Mesh, params_template, learning_rate: float,
                     sp_layout: str = "contiguous",
                     attn_inner_block=None, scan_layers: bool = False,
                     remat_policy=None,
-                    overlap_grad_reduce: bool = False):
+                    overlap_grad_reduce: bool = False,
+                    fsdp_blocks: bool = False):
   """Jitted SGD train step over GLOBAL (params, tokens, labels):
   tokens/labels (batch, seq) in NORMAL order, sharded (data, seq) --
   the data axis is 'batch' on compose_on_model_axis meshes, 'replica'
@@ -529,7 +640,47 @@ def make_train_step(mesh: Mesh, params_template, learning_rate: float,
         "live in the scanned block body (an unscanned stack already "
         "exposes every layer's reduction to the scheduler separately)")
   data_axis = _data_axis(mesh)
-  if scan_layers:
+  fsdp_hook = None
+  if fsdp_blocks:
+    # --shard_params's composed-trainer leg: the scanned layer stack
+    # stores as fsdp_stack_blocks() per-layer shards over the combined
+    # (data, seq) axes; each scan iteration gathers ONE block inside
+    # the body and its cotangent reduce-scatters there too
+    # (_fsdp_block_hook). Tensor sharding is a DIFFERENT decomposition
+    # of the same leaves (each device holds a head/feature slice, not
+    # a flat range), so composing both on one leaf is out of scope --
+    # FSDP owns the whole block here.
+    if not scan_layers:
+      raise ValueError(
+          "fsdp_blocks=True requires scan_layers=True: the per-block "
+          "gather lives in the scanned body (an unscanned stack would "
+          "re-assemble every layer at once -- full residency, nothing "
+          "sharded)")
+    if overlap_grad_reduce:
+      raise ValueError(
+          "fsdp_blocks=True cannot compose with overlap_grad_reduce: "
+          "the gather hook's backward IS the block's in-loop gradient "
+          "reduce-scatter; a second in-backward reduction would "
+          "double-reduce the block cotangents")
+    if int(mesh.shape[TENSOR_AXIS]) != 1:
+      raise ValueError(
+          "fsdp_blocks=True requires a 1-wide tensor axis: tensor "
+          "sharding slices block leaves by head/feature while FSDP "
+          "slices them by flat range -- one leaf cannot carry both "
+          f"decompositions (got tensor axis {mesh.shape[TENSOR_AXIS]})")
+    block_template = params_template["blocks"]
+    if isinstance(block_template, (list, tuple)):
+      raise ValueError(
+          "fsdp_blocks=True takes the ORIGINAL stack_blocks() tree as "
+          "params_template (full per-layer shapes drive the gather "
+          "spec); convert the live params with fsdp_stack_blocks")
+    per_layer_template = jax.tree.map(
+        lambda t: jax.ShapeDtypeStruct(tuple(t.shape)[1:], t.dtype),
+        block_template)
+    fsdp_hook = _fsdp_block_hook(per_layer_template,
+                                 (data_axis, SEQ_AXIS))
+    specs = fsdp_param_specs(data_axis)
+  elif scan_layers:
     if isinstance(params_template["blocks"], (list, tuple)):
       raise ValueError(
           "scan_layers=True takes a stack_blocks() params tree "
@@ -543,13 +694,20 @@ def make_train_step(mesh: Mesh, params_template, learning_rate: float,
 
   def body(params, tokens, labels):
     def local_loss(p):
+      if fsdp_hook is not None:
+        # Local storage view: (L, 1, k) shard rows -> the (L, k) per-
+        # layer flat shards the scan slices (the squeeze sits inside
+        # the loss so the gradient lands back on the storage layout).
+        p = dict(p)
+        p["blocks"] = jax.tree.map(lambda x: x[:, 0], p["blocks"])
       logits, moe_aux = forward_local(
           p, tokens, moe_capacity=moe_capacity, sp_layout=sp_layout,
           attn_inner_block=attn_inner_block,
           remat_policy=remat_policy,
           expert_axis=data_axis,
           grad_reduce_axes=((data_axis, SEQ_AXIS)
-                            if overlap_grad_reduce else None))
+                            if overlap_grad_reduce else None),
+          fsdp_gather_hook=fsdp_hook)
       return (_loss_from_logits(logits, labels)
               + moe_aux_weight * moe_aux)
 

@@ -183,6 +183,28 @@ flags.DEFINE_boolean("shard_optimizer_state", False,
                      "parameter_server family only; composes with "
                      "--steps_per_dispatch and --num_grad_accum; "
                      "exclusions in validation.py.")
+flags.DEFINE_boolean("shard_params", False,
+                     "Full FSDP (ZeRO-3, Rajbhandari et al.): params "
+                     "live as 1/n flat shards between steps (the same "
+                     "(n, k) stacked layout as the sharded optimizer "
+                     "state; per-layer rows for scanned stacks) and "
+                     "the step re-assembles them per builder-layer "
+                     "bucket / per scanned transformer block INSIDE "
+                     "the forward/backward with one packed all-gather "
+                     "each (ops/overlap.py gather_params; the bucket "
+                     "bound is --reduce_bucket_mb, default 4 MiB), so "
+                     "peak param residency is one bucket/block and "
+                     "steady-state per-device param HBM is |params|/n "
+                     "-- the full tree never materializes and the "
+                     "sharded path's trailing all-gather is gone. "
+                     "Gradients arrive reduce-scattered by the gather "
+                     "hooks' backward (bit-identical per element to "
+                     "the post-hoc scatter at f32). Requires "
+                     "--shard_optimizer_state (elementwise-optimizer "
+                     "family, same exclusions; validation.py); under "
+                     "--num_grad_accum the in-compute gathers "
+                     "disengage (one whole-tree gather per step, like "
+                     "the overlap hooks' accum rule).")
 flags.DEFINE_enum("variable_update", "replicated",
                   ("independent", "parameter_server", "replicated",
                    "distributed_replicated", "distributed_all_reduce",
@@ -342,6 +364,17 @@ flags.DEFINE_boolean("staged_vars", False,
                      "variable_mgr.py:246-274 StagedVariableGetter).")
 flags.DEFINE_string("train_dir", None,
                     "Checkpoint/summary directory (ref :585-588).")
+flags.DEFINE_string("compilation_cache_dir", None,
+                    "Persistent XLA compilation-cache directory "
+                    "(jax.config compilation_cache_dir, set in "
+                    "benchmark.py before the first trace): a program "
+                    "shape compiles ONCE ever -- later runs deserialize "
+                    "the cached executable, so the 30-min first-compile-"
+                    "over-the-tunnel hazard (CLAUDE.md) is paid once "
+                    "per shape. Unset = derived as <train_dir>/"
+                    "xla_cache when --train_dir is set, else off; the "
+                    "compile ledger's cache_hit column (tracing.py) "
+                    "records which episodes the cache covered.")
 flags.DEFINE_boolean("health_stats", None,
                      "In-step training-health stats (telemetry.py): the "
                      "train step additionally returns a compact f32 "

@@ -400,6 +400,10 @@ class RunTrace:
       for k, v in e.items():
         if k not in ("key", "wall_s"):
           row.setdefault(k, v)
+      if "cache_hit" in e:
+        # Last value wins: the shape's FIRST run legitimately misses
+        # and every later run should read as the hit it was.
+        row["cache_hit"] = e["cache_hit"]
     payload = {"run_id": self.run_id, "entries": entries}
     try:
       os.makedirs(train_dir, exist_ok=True)

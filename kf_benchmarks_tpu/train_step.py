@@ -94,6 +94,38 @@ def l2_loss(params, single_op: bool = False):
                    for l in leaves)
 
 
+def _l2_loss_mixed(params, shard_prefixes, axis_all, single_op=False):
+  """:func:`l2_loss` over a mixed FSDP tree (--shard_params on a
+  scanned-stack model): non-prefix leaves are the gathered FULL values
+  and keep the exact tf.nn.l2_loss formula; leaves under
+  ``shard_prefixes`` are flat local shards of the scanned stacks, so
+  their term reduces shard-locally and psums over the whole mesh --
+  exact in value (the shards tile the stack exactly once and the zero
+  pad contributes nothing) but reassociated, hence not bit-identical
+  to the replicated-param L2 (logged once by make_step_fns)."""
+  full_leaves, shard_leaves = [], []
+  for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+    if _is_batch_norm_param(path):
+      continue
+    if sharded_lib.top_level_key(path) in shard_prefixes:
+      shard_leaves.append(leaf)
+    else:
+      full_leaves.append(leaf)
+  if single_op and full_leaves:
+    flat_vec = jnp.concatenate([jnp.ravel(l).astype(jnp.float32)
+                                for l in full_leaves])
+    base = 0.5 * jnp.sum(flat_vec * flat_vec)
+  else:
+    base = 0.5 * sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                     for l in full_leaves) if full_leaves \
+        else jnp.float32(0.0)
+  if shard_leaves:
+    local = 0.5 * sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                      for l in shard_leaves)
+    base = base + lax.psum(local, axis_all)
+  return base
+
+
 def _sync_schedule_counts(src_state, dst_state, bump: int = 0):
   """Copy every ``count`` leaf of ``src_state`` (+``bump``) into
   ``dst_state``.
@@ -167,6 +199,49 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
         "--shard_optimizer_state requires the named 2-D ('batch', "
         "'model') mesh (parallel/mesh.py build_mesh_2d); got axes "
         f"{mesh.axis_names}")
+  # --shard_params (full FSDP, ZeRO-3): params live as the (n, k) /
+  # (n, L, k) shard stacks of ops/sharded.fsdp_stacked_shards between
+  # steps and are re-assembled per builder-layer bucket (loss top) /
+  # per scanned block (inside the nn.scan body -- the module's own
+  # gather hook, model.fsdp_gathered_prefixes) DURING the
+  # forward/backward; the optimizer applies on the shard and NO
+  # trailing full-tree all-gather remains -- peak param residency is
+  # one bucket/block, steady-state per-device param HBM is |params|/n.
+  sharded_params = bool(getattr(params, "shard_params", False))
+  if sharded_params and not sharded_state:
+    raise ValueError(
+        "--shard_params requires --shard_optimizer_state: the FSDP "
+        "forward consumes the sharded family's scatter/apply machinery "
+        "(ops/sharded.py); validation.py rejects the pair upstream")
+  fsdp_template = None
+  fsdp_module_prefixes = ()
+  fsdp_bucket_bytes = 0
+  if sharded_params:
+    fsdp_module_prefixes = tuple(
+        getattr(model, "fsdp_gathered_prefixes", ()) or ())
+    mb = (getattr(params, "reduce_bucket_mb", None)
+          or overlap_lib.DEFAULT_BUCKET_MB)
+    fsdp_bucket_bytes = int(mb) * 1024 * 1024
+    # Full-shape template (abstract -- nothing executes): the gather
+    # specs, the eval/accum whole-tree re-assembly and the checkpoint
+    # layout all key on it. Mirrors init_state's module.init exactly.
+    in_shapes = model.get_input_shapes("train")
+    in_dtypes = model.get_input_data_types("train")
+    sample = jnp.zeros(tuple(in_shapes[0]), in_dtypes[0])
+    fsdp_template = jax.eval_shape(
+        lambda: module.init({"params": jax.random.PRNGKey(0),
+                             "dropout": jax.random.PRNGKey(0)},
+                            sample))["params"]
+    if fsdp_module_prefixes and (params.weight_decay or 0.0):
+      from kf_benchmarks_tpu.utils import log as log_util
+      log_util.log_fn(
+          "shard_params: weight decay over the scanned parameter "
+          f"stack(s) {list(fsdp_module_prefixes)} reduces shard-"
+          "locally + one mesh psum (full blocks exist only one at a "
+          "time inside the scan): exact L2 value, reassociated -- "
+          "total_loss is not bit-identical to the replicated-param L2 "
+          "on this model family (pass --weight_decay=0 for bit-exact "
+          "A/Bs)")
   weight_decay = params.weight_decay or 0.0
   # Loss-scale resolution (ref: benchmark_cnn.py:471-480 "None = model
   # default"): float16 compute defaults to the model's scale (128);
@@ -265,6 +340,14 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
     variables = module.init({"params": rng, "dropout": rng}, sample_images)
     model_params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
+    if sharded_params:
+      # Full FSDP: the PARAM storage itself is the shard stack (per-
+      # layer rows for the scanned prefixes), and the per-shard
+      # optimizer state mirrors it leaf-for-leaf -- tx.init vmapped
+      # over the uniform leading shard-row dim.
+      params_store = sharded_lib.fsdp_stacked_shards(
+          model_params, num_replicas, fsdp_module_prefixes)
+      return params_store, jax.vmap(tx.init)(params_store), batch_stats
     if sharded_state:
       # Per-shard optimizer state: vmap tx.init over the stacked flat
       # param shards (ops/sharded.py layout), so every opt-state leaf
@@ -280,8 +363,10 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
     """Builds the stacked per-replica TrainState (identical init on every
     replica == the reference's post-init broadcast, variable_mgr.py:342-356).
     Under --shard_optimizer_state the opt_state rows are per-device
-    SHARDS, not copies (see _init)."""
-    model_params, opt_state, batch_stats = _init(rng, sample_images)
+    SHARDS, not copies (see _init); under --shard_params the params
+    rows are shards too (the FSDP steady state -- per-device param HBM
+    |params|/n)."""
+    params_store, opt_state, batch_stats = _init(rng, sample_images)
     stack = lambda t: jax.tree.map(
         lambda x: jnp.broadcast_to(x[None], (num_replicas,) + x.shape), t)
     buffers = {}
@@ -289,12 +374,12 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
       # Warmed up with zero gradients, like the reference's StagingArea
       # warmup put (ref: batch_allreduce.py:357-359).
       buffers["deferred_grads"] = stack(
-          jax.tree.map(jnp.zeros_like, model_params))
+          jax.tree.map(jnp.zeros_like, params_store))
     if staged_vars:
-      buffers["staged_params"] = stack(model_params)
+      buffers["staged_params"] = stack(params_store)
     return TrainState(
         step=jnp.zeros((), jnp.int32),
-        params=stack(model_params),
+        params=params_store if sharded_params else stack(params_store),
         opt_state=opt_state if sharded_state else stack(opt_state),
         batch_stats=stack(batch_stats),
         loss_scale=jnp.asarray(init_loss_scale, jnp.float32),
@@ -303,6 +388,14 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
         buffers=buffers)
 
   # -- train step -----------------------------------------------------------
+
+  # --shard_params engagement mirrors the overlap hooks' rule: under
+  # --num_grad_accum the in-compute per-bucket gathers DISENGAGE -- the
+  # full tree is re-assembled once before the microbatch scan and the
+  # accumulated gradient is scattered post-hoc (so the scatter still
+  # meets the accumulated sums in the same order as the round-11 path:
+  # bit-identity is preserved; the param-residency win is accum=1's).
+  fsdp_in_step = sharded_params and num_grad_accum == 1
 
   def per_replica_train(state, images, labels):
     model_params = _squeeze(state.params)
@@ -314,6 +407,12 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
     # variable_mgr_util.py:313-393).
     forward_params = (buffers["staged_params"] if staged_vars
                       else model_params)
+    if sharded_params and not fsdp_in_step:
+      # FSDP + accumulation: one whole-tree gather up front (the
+      # round-11 steady state, rotated to the step top), full-tree
+      # microbatch scan, post-hoc scatter below.
+      forward_params = sharded_lib.fsdp_gather_full(
+          model_params, fsdp_template, fsdp_module_prefixes)
     # Data-replica id: on the 2-D mesh, model-axis peers fold the SAME
     # id (same batch shard, same dropout stream), which is what makes
     # their local gradients identical by construction -- the free
@@ -342,6 +441,20 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
             p, axis_data, overlap_spec.bucket_bytes,
             compact_dtype=overlap_spec.compact_dtype,
             exclude_prefixes=module_reduced_prefixes)
+      if fsdp_in_step:
+        # FSDP per-bucket gather (ops/overlap.py gather_params): every
+        # non-module-gathered leaf of p below is the RE-ASSEMBLED full
+        # value (one packed all-gather per builder-layer bucket), the
+        # module-gathered scanned stacks stay shards for the per-block
+        # hook inside the nn.scan body; jax.grad then returns shard-
+        # layout gradients already reduce-scattered (batch mean + free
+        # model sub-slice), one collective per bucket/block, each
+        # issued where that bucket's backward completes. The unscale-
+        # after-scatter ordering is exact for the same power-of-two
+        # reason as the overlap hooks above.
+        p = overlap_lib.fsdp_wrap_shards(
+            p, fsdp_template, fsdp_bucket_bytes, BATCH_AXIS, MODEL_AXIS,
+            exclude_prefixes=fsdp_module_prefixes)
       variables = {"params": p}
       if bs:
         variables["batch_stats"] = bs
@@ -354,8 +467,22 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
       base_loss = model.loss_function(result, mb_labels)
       total_loss = base_loss
       if weight_decay:
-        total_loss = total_loss + weight_decay * l2_loss(
-            p, single_op=params.single_l2_loss_op)
+        if fsdp_in_step and fsdp_module_prefixes:
+          # The scanned-stack leaves of p are SHARDS here (their full
+          # values exist only block-at-a-time inside the scan), so
+          # their L2 term reduces shard-locally + one scalar psum over
+          # the mesh -- exact in value (shards tile the stack once,
+          # pad is zero) but reassociated, so total_loss is NOT
+          # bit-identical to the replicated-param L2 for scanned
+          # models with weight decay (the make_step_fns note logs
+          # this; the gathered non-scanned leaves keep the exact
+          # legacy term).
+          total_loss = total_loss + weight_decay * _l2_loss_mixed(
+              p, fsdp_module_prefixes, axis_all,
+              single_op=params.single_l2_loss_op)
+        else:
+          total_loss = total_loss + weight_decay * l2_loss(
+              p, single_op=params.single_l2_loss_op)
       scaled = total_loss * state.loss_scale
       return scaled, (base_loss, total_loss, new_bs, result)
 
@@ -490,7 +617,20 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
       noise_stats = elastic_lib.noise_scale_stats(
           grads, axis_data, images.shape[0])
     grad_shards = None
-    if sharded_state:
+    if fsdp_in_step:
+      # Full FSDP: the in-backward gather hooks already reduce-
+      # scattered every bucket/block cotangent onto the shard layout
+      # (ops/overlap.py gather_params bwd -- elementwise identical to
+      # the post-hoc scatter below); jax.grad's output IS the shard
+      # tree. No full gradient tree ever existed.
+      grad_shards = grads
+    elif sharded_params:
+      # FSDP + accumulation: post-hoc scatter of the accumulated full
+      # tree onto the FSDP layout (per-layer rows for the scanned
+      # stacks) -- elementwise the same values as scatter_mean.
+      grad_shards = sharded_lib.fsdp_scatter_mean(grads,
+                                                  fsdp_module_prefixes)
+    elif sharded_state:
       # ZeRO gradient pass (ops/sharded.py): reduce-scatter of the
       # batch-axis mean -- each scatter group meets the same B distinct
       # contributions in the same group order as the replicated pmean,
@@ -544,17 +684,24 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
                                            axis_data)
     if sharded_state:
       # The ZeRO apply (the reference's central variable placement
-      # rendered SPMD, variable_mgr.py:201-243): slice this device's
-      # flat param shard (free -- params are replica-identical), run
-      # the optimizer on the 1/n shard ONLY (elementwise optimizers;
-      # validation.py rejects LARS), and all-gather the updated params
-      # for the next forward. Optimizer HBM per device is |state|/n.
-      param_shards = sharded_lib.local_shards(model_params_pre)
+      # rendered SPMD, variable_mgr.py:201-243): run the optimizer on
+      # the 1/n shard ONLY (elementwise optimizers; validation.py
+      # rejects LARS). Optimizer HBM per device is |state|/n.
+      # --shard_params: the state ALREADY holds this device's shards
+      # (the FSDP steady state) and the updated shards flow straight
+      # back into it -- the round-11 trailing full-tree all-gather is
+      # GONE; re-assembly happens inside the next step's compute, one
+      # bucket/block at a time. Without it, params are replicated: the
+      # shard is a free local slice and the updated params return by
+      # all-gather for the next forward.
+      param_shards = (model_params_pre if sharded_params
+                      else sharded_lib.local_shards(model_params_pre))
       with jax.named_scope("optimizer_apply"):
         updates, new_opt_state = tx.update(grad_shards, opt_state,
                                            param_shards)
         new_shards = optax.apply_updates(param_shards, updates)
-      new_params = sharded_lib.gather_tree(new_shards, model_params_pre)
+      new_params = (new_shards if sharded_params else
+                    sharded_lib.gather_tree(new_shards, model_params_pre))
     elif getattr(strategy, "sequential_apply", False):
       # Async PS with a stateful optimizer (strategies.py): serialize
       # every replica's unaveraged gradient through the SHARED optimizer
@@ -820,6 +967,13 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
 
   def per_replica_eval(state, images, labels):
     model_params = _squeeze(state.params)
+    if sharded_params:
+      # Mid-training eval re-assembles the full tree (the eval module
+      # carries no FSDP hooks); eval is occasional, so the transient
+      # full-tree residency is acceptable -- the steady-state training
+      # program is what the residency contract binds.
+      model_params = sharded_lib.fsdp_gather_full(
+          model_params, fsdp_template, fsdp_module_prefixes)
     batch_stats = _squeeze(state.batch_stats)
     variables = {"params": model_params}
     if batch_stats:

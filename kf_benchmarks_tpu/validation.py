@@ -64,11 +64,15 @@ NO_CROSS_FLAG_VALIDATION = {
     "display_every": "display cadence only",
     "print_training_accuracy": "adds metric columns only",
     "benchmark_log_dir": "artifact sink path",
+    "compilation_cache_dir": "cache directory path; any writable path "
+                             "works with every mode (benchmark.py "
+                             "derives <train_dir>/xla_cache when unset)",
     "benchmark_test_id": "artifact metadata string",
     "eval_dir": "artifact sink path",
     "eval_interval_secs": "eval-loop cadence only",
     "save_summaries_steps": "summary cadence only",
-    "summary_verbosity": "summary tier selector (observability.py caps)",
+    # (summary_verbosity left this list when --shard_params began
+    # cross-checking the tier-2 histogram surface.)
     "loss_type_to_report": "display column selector",
     "use_chrome_trace_format": "output-format toggle of the "
                                "--trace_events_file exporter (tracing.py:"
@@ -374,6 +378,34 @@ def validate_cross_flags(params) -> None:
           "per-step update tree (telemetry.py health_partials), and "
           "the sharded apply only materializes this device's 1/n "
           "update shard. Drop the flag (auto-off with a note)")
+  if getattr(p, "shard_params", False):
+    # --shard_params (full FSDP): params join the optimizer state on
+    # the (n, k) shard layout and re-assemble inside the compute
+    # (train_step.py + ops/overlap.py). Requiring
+    # --shard_optimizer_state makes the whole sharded exclusion matrix
+    # above binding here too -- elementwise-optimizer family only (no
+    # LARS), synchronous replicated/parameter_server only (no
+    # async-PS, no independent/gossip), no staged vars / relaxed
+    # consistency / overlap reducers, single-process.
+    if not sharded:
+      raise ParamError(
+          "--shard_params requires --shard_optimizer_state: the FSDP "
+          "forward rides the sharded family's scatter/apply machinery "
+          "(reduce-scatter mean, 1/n shard apply, the (n, k) "
+          "checkpoint layout), and params-sharded-but-state-replicated "
+          "would re-create exactly the per-device footprint ZeRO "
+          "removes. Add --shard_optimizer_state (which also brings its "
+          "exclusion matrix: elementwise optimizers only, synchronous "
+          "replicated/parameter_server only, no --staged_vars)")
+    if (p.summary_verbosity or 0) >= 2:
+      raise ParamError(
+          "--summary_verbosity >= 2 cannot be combined with "
+          "--shard_params: the tier-2 parameter histograms read the "
+          "replica-0 FULL parameter tree (observability.py "
+          "write_histograms), which the FSDP layout stores as 1/n "
+          "flat shards -- the histograms would silently describe one "
+          "shard. Use verbosity 1 (scalars) or drop --shard_params "
+          "for histogram debugging")
   if getattr(p, "fault_schedule", None):
     # Malformed schedules fail at startup, not at the named step: a
     # fault harness that silently skips its fault proves nothing.
@@ -647,12 +679,14 @@ def validate_cross_flags(params) -> None:
           "--hierarchical_copy -- or drop the flag (a silent no-op "
           "that logs a halved-bytes note would misrecord the run)")
   if getattr(p, "reduce_bucket_mb", None) and \
-      not getattr(p, "overlap_gradient_reduction", False):
+      not (getattr(p, "overlap_gradient_reduction", False)
+           or getattr(p, "shard_params", False)):
     raise ParamError(
-        "--reduce_bucket_mb sizes the in-backward reduction buckets and "
-        "requires --overlap_gradient_reduction (the post-hoc paths' "
+        "--reduce_bucket_mb sizes the in-backward collective buckets "
+        "and requires --overlap_gradient_reduction (reduction buckets) "
+        "or --shard_params (FSDP gather buckets); the post-hoc paths' "
         "granularity levers are --gradient_repacking / "
-        "--agg_small_grads_max_bytes / --all_reduce_spec)")
+        "--agg_small_grads_max_bytes / --all_reduce_spec")
   if getattr(p, "overlap_gradient_reduction", False):
     # In-backward reduction replaces the strategy's post-hoc gradient
     # pass with per-bucket pmeans issued inside the backward; it is

@@ -184,6 +184,21 @@ def test_rescale_4_to_8_bit_identical(tmp_path):
 
 
 @pytest.mark.slow
+def test_rescale_8_to_4_fsdp_bit_identical(tmp_path):
+  """--shard_params (round 15): the FSDP param layout rides the same
+  seam -- the (n, k) param stacks re-slice through checkpoint._reshard
+  exactly like the optimizer state (params_layout marker +
+  cross-topology re-address), and the resumed peer at the new size
+  matches bit-for-bit."""
+  logs_a, _ = _assert_rescale_bit_identical(tmp_path, 8, 4,
+                                            shard_params=True)
+  # The seam snapshot really carries the FSDP layout.
+  snap = checkpoint.load_checkpoint(
+      os.path.join(str(tmp_path / "b"), "model.ckpt-4.msgpack"))
+  assert snap.get("params_layout") == "sharded"
+
+
+@pytest.mark.slow
 def test_rescale_event_recorded_in_flight_window(tmp_path):
   """The elastic run (health auto-off under --shard_optimizer_state)
   still gets a telemetry session: the flight-recorder window carries

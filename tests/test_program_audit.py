@@ -257,6 +257,32 @@ MUTATIONS = [
     ("traced_device_side_reduction", "traced",
      lambda c: _add_collective(c),
      "trace-twin"),
+    # PR 10 seeds (--shard_params). A single all-gather re-assembling
+    # the whole parameter tree is params leaking back to replicated
+    # residency -- the exact buffer FSDP exists to never materialize.
+    # (Full-mesh groups, so sharded-collectives stays quiet; only the
+    # residency rule may fire.)
+    ("fsdp_full_tree_gather", "fsdp_base",
+     lambda c: _add_collective(
+         c, kind="all-gather",
+         elems=c.aux["fsdp_param_full_bytes"] // 4 + 1,
+         replica_groups="{{0,1,2,3,4,5,6,7}}"),
+     "fsdp-residency"),
+    # The round-11 trailing re-gather returns: extra bucket-sized
+    # all-gathers beyond the planned step buckets mean the steady
+    # state re-assembles params it should leave sharded.
+    ("fsdp_trailing_regather_leak", "fsdp_base",
+     lambda c: _add_collective(
+         c, kind="all-gather", elems=4096,
+         replica_groups="{{0,1,2,3,4,5,6,7}}"),
+     "fsdp-residency"),
+    # The scanned LM's per-block gather hoisted out of the scan body:
+    # the whole layer stack would re-assemble at once.
+    ("fsdp_block_gather_left_the_loop", "fsdp_lm",
+     lambda c: c.collectives.__setitem__(
+         slice(None), [x for x in c.collectives
+                       if not (x.kind == "all-gather" and x.in_loop)]),
+     "fsdp-residency"),
 ]
 
 

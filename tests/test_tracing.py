@@ -517,3 +517,34 @@ def test_trace_on_bit_identical_to_off(tmp_path, extra):
                   jax.tree.leaves(off["state"].params)):
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
   _schema_checked(str(tmp_path / "t.json"))
+
+
+def test_compilation_cache_wired_and_ledger_cache_hit(tmp_path):
+  """--compilation_cache_dir (ROADMAP item 3 groundwork): the cache
+  dir defaults to <train_dir>/xla_cache and is configured before the
+  first trace; a SECOND run of the same train_dir ledgers its compile
+  episodes as cache_hit=True (the fingerprint was ledgered by the
+  first run and the persistent cache is live), so the once-per-shape
+  payoff is visible in the ledger rows."""
+  train_dir = str(tmp_path / "train")
+  logs1, stats1 = _run_and_scrape(num_batches=2, train_dir=train_dir)
+  assert any(l.startswith("XLA compilation cache: ") for l in logs1)
+  assert os.path.isdir(os.path.join(train_dir, "xla_cache"))
+  entries1 = stats1["compile_ledger"]["entries"]
+  assert entries1 and all(e["cache_hit"] is False for e in entries1)
+  logs2, stats2 = _run_and_scrape(num_batches=2, train_dir=train_dir)
+  entries2 = stats2["compile_ledger"]["entries"]
+  assert entries2 and all(e["cache_hit"] is True for e in entries2)
+  # The merged on-disk ledger keeps the LAST cache_hit (a shape's
+  # first run legitimately misses; later runs read as the hit they
+  # were).
+  data = json.load(open(os.path.join(train_dir, "compile_ledger.json")))
+  assert all(row.get("cache_hit") is True
+             for row in data["entries"].values())
+  # Explicit path override wins over the train_dir default.
+  other = str(tmp_path / "explicit_cache")
+  logs3, _ = _run_and_scrape(num_batches=2,
+                             train_dir=str(tmp_path / "t2"),
+                             compilation_cache_dir=other)
+  assert any(l == f"XLA compilation cache: {other}" for l in logs3)
+  assert os.path.isdir(other)

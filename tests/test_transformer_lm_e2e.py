@@ -5,11 +5,14 @@ BenchmarkCNN on the virtual mesh -- minutes on CPU, so it lives in the
 slow suite (run_tests.py SLOW_TESTS) like the whole-zoo build test.
 """
 
+import re
+
 import numpy as np
 import pytest
 
 from kf_benchmarks_tpu import benchmark
 from kf_benchmarks_tpu import params as params_lib
+from kf_benchmarks_tpu.utils import log as log_util
 
 
 @pytest.mark.slow
@@ -22,3 +25,44 @@ def test_trains_through_stock_benchmark_path():
       variable_update="replicated", optimizer="sgd",
       display_every=1)).run()
   assert np.isfinite(stats["last_average_loss"])
+
+
+_STEP_RE = re.compile(
+    r"^(\d+)\timages/sec: [\d.]+ \+/- [\d.]+ \(jitter = [\d.]+\)\t(.*)$")
+
+
+@pytest.mark.slow
+def test_fsdp_bit_identical_full_size_lm():
+  """--shard_params on the FULL-size scanned LM through the stock
+  benchmark path: per-step f32 losses bit-identical to
+  --shard_optimizer_state alone (weight_decay=0 -- the scanned-stack
+  L2 is exact-but-reassociated under FSDP, train_step.py), and
+  per-device param bytes drop ~n-fold. Slow tier: ~3 min per step
+  program on the CPU mesh. (The per-block gather path itself is
+  equivalence-pinned in tier 1 on a small scanned model,
+  tests/test_fsdp.py.)"""
+  def run(**kw):
+    logs = []
+    orig = log_util.log_fn
+    log_util.log_fn = logs.append
+    try:
+      defaults = dict(model="transformer_lm", num_batches=2,
+                      num_warmup_batches=0, device="cpu",
+                      display_every=1, batch_size=1, num_devices=8,
+                      optimizer="momentum", weight_decay=0.0,
+                      shard_optimizer_state=True)
+      defaults.update(kw)
+      stats = benchmark.BenchmarkCNN(
+          params_lib.make_params(**defaults)).run()
+    finally:
+      log_util.log_fn = orig
+    cols = [(m.group(1), m.group(2)) for l in logs
+            if (m := _STEP_RE.match(l))]
+    return cols, stats
+
+  cols_a, stats_a = run()
+  cols_b, stats_b = run(shard_params=True)
+  assert cols_a and cols_a == cols_b
+  assert stats_a["last_average_loss"] == stats_b["last_average_loss"]
+  assert stats_b["param_bytes_per_device"] * 7 \
+      < stats_a["param_bytes_per_device"]

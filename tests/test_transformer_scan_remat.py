@@ -261,3 +261,139 @@ def test_scanned_program_is_depth_independent():
       transformer.stack_blocks(params), tokens, labels).as_text()
   assert len(text_scan) < len(text_list) / 2, (
       len(text_scan), len(text_list))
+
+
+# -- parallel/transformer.py: FSDP blocks (--shard_params's composed leg) -----
+
+def test_fsdp_stack_unstack_roundtrip():
+  params, _, _ = _setup(n_layers=3)
+  stacked = transformer.stack_blocks(params)
+  fsdp = transformer.fsdp_stack_blocks(stacked, 8)
+  for leaf in jax.tree.leaves(fsdp["blocks"]):
+    assert leaf.shape[:2] == (3, 8)
+  back = transformer.fsdp_unstack_blocks(fsdp, stacked["blocks"])
+  for a, b in zip(jax.tree.leaves(stacked), jax.tree.leaves(back)):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fsdp_blocks_rejections():
+  params, _, _ = _setup()
+  stacked = transformer.stack_blocks(params)
+  mesh = transformer.build_mesh(2, 2, 2)
+  with pytest.raises(ValueError, match="scan_layers"):
+    transformer.make_train_step(mesh, stacked, learning_rate=0.1,
+                                fsdp_blocks=True)
+  with pytest.raises(ValueError, match="tensor"):
+    transformer.make_train_step(mesh, stacked, learning_rate=0.1,
+                                scan_layers=True, fsdp_blocks=True)
+  mesh_dp = transformer.build_mesh(4, 2, 1)
+  with pytest.raises(ValueError, match="double-reduce"):
+    transformer.make_train_step(mesh_dp, stacked, learning_rate=0.1,
+                                scan_layers=True, fsdp_blocks=True,
+                                overlap_grad_reduce=True)
+
+
+def test_fsdp_blocks_forward_loss_matches_scanned():
+  """Step-0 loss on a (4, 2, 1) dp x sp mesh: the per-block gather
+  re-assembles exactly the scanned stack's values, so the first
+  forward's loss matches the replicated-blocks arm (pre-vma safe: the
+  comparison reads the loss of the SAME params before any update)."""
+  params, tokens, labels = _setup(n_layers=2)
+  mesh = transformer.build_mesh(4, 2, 1)
+  stacked = transformer.stack_blocks(params)
+  step_scan = transformer.make_train_step(mesh, stacked,
+                                          learning_rate=0.1,
+                                          scan_layers=True)
+  step_fsdp = transformer.make_train_step(mesh, stacked,
+                                          learning_rate=0.1,
+                                          scan_layers=True,
+                                          fsdp_blocks=True)
+  n_data = 4 * 2
+  _, l_scan = step_scan(jax.tree.map(jnp.copy, stacked), tokens, labels)
+  _, l_fsdp = step_fsdp(transformer.fsdp_stack_blocks(stacked, n_data),
+                        tokens, labels)
+  np.testing.assert_allclose(float(l_fsdp), float(l_scan),
+                             rtol=1e-6, atol=1e-7)
+
+
+def test_fsdp_blocks_gather_sits_inside_scan_body():
+  """The composed-trainer residency pin: the per-block all-gather (and
+  its backward reduce-scatter) lowers INSIDE the while body, and no
+  gather re-assembles the whole (L, ...) stack at once."""
+  from kf_benchmarks_tpu.analysis import contracts
+  params, tokens, labels = _setup(n_layers=4)
+  mesh = transformer.build_mesh(4, 2, 1)
+  stacked = transformer.stack_blocks(params)
+  step = transformer.make_train_step(mesh, stacked, learning_rate=0.1,
+                                     scan_layers=True, fsdp_blocks=True)
+  fsdp = transformer.fsdp_stack_blocks(stacked, 8)
+  hlo = step.lower(fsdp, tokens, labels).compile().as_text()
+  c = contracts.extract_contract(hlo)
+  ags = [x for x in c.collectives
+         if x.kind == "all-gather" and not x.scalar]
+  assert any(x.in_loop for x in ags), "per-block gather left the scan"
+  assert any(x.kind == "reduce-scatter" and x.in_loop
+             for x in c.collectives), "block scatter left the scan"
+  blocks_bytes = sum(int(np.prod(l.shape)) * 4
+                     for l in jax.tree.leaves(stacked["blocks"]))
+  for x in ags:
+    assert x.elems * 4 < blocks_bytes, "a gather re-assembles the stack"
+
+
+def test_fsdp_blocks_training_matches_scanned_degenerate_mesh():
+  """n = 1 training equality (pre-vma safe: every collective is over a
+  singleton group, so the pre-vma transpose gap cannot bite): the
+  whole FSDP pipeline -- shard storage, in-scan gather, custom-vjp
+  scatter, shard update -- reduces to the scanned step exactly."""
+  params, tokens, labels = _setup(n_layers=2)
+  mesh = transformer.build_mesh(1, 1, 1)
+  stacked = transformer.stack_blocks(params)
+  step_scan = transformer.make_train_step(mesh, stacked,
+                                          learning_rate=0.1,
+                                          scan_layers=True)
+  step_fsdp = transformer.make_train_step(mesh, stacked,
+                                          learning_rate=0.1,
+                                          scan_layers=True,
+                                          fsdp_blocks=True)
+  p_scan = jax.tree.map(jnp.copy, stacked)
+  p_fsdp = transformer.fsdp_stack_blocks(stacked, 1)
+  for _ in range(3):
+    p_scan, l_scan = step_scan(p_scan, tokens, labels)
+    p_fsdp, l_fsdp = step_fsdp(p_fsdp, tokens, labels)
+    np.testing.assert_allclose(float(l_fsdp), float(l_scan),
+                               rtol=1e-6, atol=1e-7)
+  back = transformer.fsdp_unstack_blocks(
+      jax.tree.map(np.asarray, p_fsdp), stacked["blocks"])
+  for a, b in zip(jax.tree.leaves(p_scan), jax.tree.leaves(back)):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pre_vma_oracle_skip
+def test_fsdp_blocks_training_matches_scanned_dp_mesh():
+  """Trained equality on the real (4, 2, 1) dp x sp mesh (vma jax
+  only: the replicated-blocks arm's gradients need the implicit
+  data-axis psums pre-vma shard_map does not insert; the FSDP arm's
+  block gradients are explicit either way)."""
+  params, tokens, labels = _setup(n_layers=2)
+  mesh = transformer.build_mesh(4, 2, 1)
+  stacked = transformer.stack_blocks(params)
+  step_scan = transformer.make_train_step(mesh, stacked,
+                                          learning_rate=0.1,
+                                          scan_layers=True)
+  step_fsdp = transformer.make_train_step(mesh, stacked,
+                                          learning_rate=0.1,
+                                          scan_layers=True,
+                                          fsdp_blocks=True)
+  p_scan = jax.tree.map(jnp.copy, stacked)
+  p_fsdp = transformer.fsdp_stack_blocks(stacked, 8)
+  for _ in range(2):
+    p_scan, l_scan = step_scan(p_scan, tokens, labels)
+    p_fsdp, l_fsdp = step_fsdp(p_fsdp, tokens, labels)
+    np.testing.assert_allclose(float(l_fsdp), float(l_scan),
+                               rtol=1e-5, atol=1e-6)
+  back = transformer.fsdp_unstack_blocks(
+      jax.tree.map(np.asarray, p_fsdp), stacked["blocks"])
+  for a, b in zip(jax.tree.leaves(p_scan), jax.tree.leaves(back)):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-4, atol=1e-5)
