@@ -17,7 +17,25 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 BASELINE_IMAGES_PER_SEC = 416.43
 
 
-def main():
+def main(argv=None):
+  import argparse
+  parser = argparse.ArgumentParser(description=__doc__)
+  parser.add_argument(
+      "--run_store_dir",
+      default=os.path.dirname(os.path.abspath(__file__)),
+      help="directory of the append-only run-record store "
+           "(metrics.py RunStore); defaults to the repo root, "
+           "alongside the BENCH_*.json trajectory")
+  parser.add_argument(
+      "--check-regression", action="store_true",
+      dest="check_regression",
+      help="compare this run against the trailing median of "
+           "same-fingerprint history in the run store (noise-aware "
+           "MAD bar, metrics.py check_regression); prints a verdict "
+           "line to stderr and exits nonzero on a regression")
+  args = parser.parse_args(argv)
+
+  from kf_benchmarks_tpu import metrics as metrics_lib
   from kf_benchmarks_tpu import params as params_lib
   from kf_benchmarks_tpu import benchmark
   from kf_benchmarks_tpu.utils import log as log_util
@@ -77,22 +95,14 @@ def main():
     print(f"TPU unreachable after {attempts} probe(s); last: {detail}; "
           "falling back to CPU", file=sys.stderr, flush=True)
     jax.config.update("jax_platforms", "cpu")
-  params = params_lib.make_params(
-      model="resnet50",
-      batch_size=256 if on_tpu else 8,
-      num_batches=None if on_tpu else 5,  # None -> the reference default
-                                          # (100, the baseline logs' config)
-      num_warmup_batches=None if on_tpu else 1,
-      device="tpu" if on_tpu else "cpu",
-      num_devices=1,
-      variable_update="replicated",
-      use_fp16=on_tpu,  # bfloat16 compute on TPU
-      optimizer="momentum",
-      display_every=10,
-      # Explicit opt-in (the bench has no train_dir, so auto would stay
-      # off): the one-line JSON carries the run-health aggregate.
-      health_stats=True,
-  )
+  # The canonical bench config lives in metrics.bench_params_kwargs --
+  # ONE copy, shared with the backfill CLI so ingested history and
+  # fresh runs compute the same config fingerprint. (num_batches=None
+  # -> the reference default, 100, the baseline logs' config;
+  # health_stats explicit opt-in -- the bench has no train_dir, so
+  # auto would stay off and the one-line JSON would lose its
+  # run-health aggregate; use_fp16 means bfloat16 compute on TPU.)
+  params = params_lib.make_params(**metrics_lib.bench_params_kwargs(on_tpu))
   params = benchmark.setup(params)
   bench = benchmark.BenchmarkCNN(params)
   stats = bench.run()
@@ -176,8 +186,64 @@ def main():
         "loss_scale_final": health.get("loss_scale_final"),
         "watchdog_stalls": health.get("watchdog_stalls"),
     }
+  # Run attribution (without these a BENCH_* line cannot be tied to a
+  # commit or to the platform it actually executed on after the fact):
+  # the git revision the run was built from and the REAL execution
+  # platform -- "cpu" exactly when the metric carries the _CPU_FALLBACK
+  # tag, so the two fields can never disagree.
+  record["git_rev"] = metrics_lib.git_revision()
+  record["platform"] = "tpu" if on_tpu else "cpu"
   print(json.dumps(record), flush=True)
+  return record_and_check(record, on_tpu, args.run_store_dir,
+                          args.check_regression,
+                          run_id=stats.get("run_id"))
+
+
+def record_and_check(record, on_tpu, store_dir, check_regression,
+                     run_id=None) -> int:
+  """Append this run's record to the run store; under
+  --check-regression, judge it against the trailing same-fingerprint
+  median and return the process exit code (nonzero = regression).
+  Split from main() so the sentinel leg is unit-testable on synthetic
+  records without running the benchmark."""
+  from kf_benchmarks_tpu import metrics as metrics_lib
+  from kf_benchmarks_tpu import tracing
+  import jax
+
+  store = metrics_lib.RunStore(store_dir)
+  try:
+    rec = metrics_lib.run_record(
+        metric=record["metric"], value=record["value"],
+        unit=record["unit"],
+        fingerprint=metrics_lib.bench_fingerprint(on_tpu),
+        # The RUN'S id (stats carry the trace session's), so the store
+        # record joins its trace/flight-recorder artifacts; minted only
+        # when the caller has none (synthetic-record tests).
+        run_id=run_id or tracing.resolve_run_id(),
+        platform=record["platform"],
+        fallback=not on_tpu,
+        git_rev=record.get("git_rev"),
+        jax_version=jax.__version__,
+        snapshot=metrics_lib.flatten_stats(record))
+    # History is read BEFORE the append so the fresh run never judges
+    # itself; the append itself runs unconditionally (the store is the
+    # bench trajectory's memory, sentinel on or off).
+    history = store.records()
+    rec = store.append(rec)
+    if rec.get("baseline"):
+      print("run store: first real-chip record for fingerprint "
+            f"{rec['fingerprint'][:16]} promoted to baseline",
+            file=sys.stderr, flush=True)
+  except (OSError, ValueError) as e:
+    print(f"run store append failed (non-fatal): {e}",
+          file=sys.stderr, flush=True)
+    return 0
+  if not check_regression:
+    return 0
+  verdict = metrics_lib.check_regression(history, rec)
+  print(metrics_lib.verdict_line(verdict), file=sys.stderr, flush=True)
+  return 1 if verdict["status"] == "regression" else 0
 
 
 if __name__ == "__main__":
-  main()
+  sys.exit(main())

@@ -160,6 +160,34 @@ def rule_trace_twin(contract, tracer):
       for field, off_v, on_v in baseline_lib.diff_fingerprints(off, on)]
 
 
+def rule_metrics_twin(contract, tracer):
+  """PR 11: the metrics fabric is HOST-ONLY. A step program traced
+  with --metrics_port / --run_store_dir set (metrics.py registry,
+  endpoint, run store) must be STRUCTURALLY IDENTICAL to the twin
+  without them -- the rule_trace_twin contract, extended to the
+  metrics session: device-side instrumentation smuggled in through the
+  registry is exactly the regression this catches."""
+  if not (_cfg(contract, "metrics_port") or
+          _cfg(contract, "run_store_dir")):
+    return []
+  if tracer is None:
+    return []
+  from kf_benchmarks_tpu.analysis import baseline as baseline_lib
+  twin_cfg = dict(contract.config)
+  twin_cfg.pop("metrics_port", None)
+  twin_cfg.pop("run_store_dir", None)
+  twin = tracer(twin_cfg, contract.program)
+  on = baseline_lib.contract_fingerprint(contract)
+  off = baseline_lib.contract_fingerprint(twin)
+  on.pop("config", None)
+  off.pop("config", None)
+  return [
+      f"metrics-on program differs from the metrics-off twin at "
+      f"{field}: {off_v!r} (off) vs {on_v!r} (on) -- the metrics "
+      "fabric must stay host-only"
+      for field, off_v, on_v in baseline_lib.diff_fingerprints(off, on)]
+
+
 def rule_health_no_extra_collective(contract, tracer):
   """PR 4: the health-on step carries NO additional collective (the
   stats ride the loss pmean)."""
@@ -533,6 +561,7 @@ def _tree_leaves(tree):
 
 RULES: Dict[str, Callable] = {
     "trace-twin": rule_trace_twin,
+    "metrics-twin": rule_metrics_twin,
     "accum-one-collective": rule_accum_one_collective,
     "overlap-in-backward": rule_overlap_in_backward,
     "no-btv-buffer": rule_no_btv_buffer,

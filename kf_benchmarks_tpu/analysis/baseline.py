@@ -78,6 +78,7 @@ PROGRAM_SHAPE_EXCLUDE = frozenset({
     "flight_recorder_window", "health_grad_norm_sigma",
     "stall_watchdog_factor", "fault_schedule",
     "elastic_check_every_n_steps", "sync_on_finish",
+    "metrics_port", "run_store_dir",
 })
 
 

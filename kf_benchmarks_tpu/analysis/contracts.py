@@ -467,4 +467,14 @@ GOLDEN_CONFIGS: "OrderedDict[str, Dict[str, Any]]" = OrderedDict([
     # the step program).
     ("traced", dict(model="trivial", batch_size=4,
                     trace_events_file="trace_events.json")),
+    # PR 11 (round 16): the metrics-twin rule's anchor. The metric
+    # registry + live /metrics endpoint (--metrics_port, metrics.py)
+    # and the run-record store (--run_store_dir) are HOST-ONLY by the
+    # same contract as tracing: the metrics-on step program must be
+    # STRUCTURALLY IDENTICAL to the metrics-off twin
+    # (audit.rule_metrics_twin). No socket is bound during tracing --
+    # the endpoint lives in the train LOOP, not the step program.
+    ("metrics_on", dict(model="trivial", batch_size=4,
+                        metrics_port=9309,
+                        run_store_dir="run_store")),
 ])

@@ -44,6 +44,16 @@ Rules (ids):
   fork the trace schema the tests validate. READING profiler output
   (``e.get("ph")``, observability.py) is fine -- only construction is
   emission.
+* ``metric-key-literal`` -- metric keys are single-sourced in the
+  metric registry schema (``metrics.py``; the same pattern as the
+  step-line and trace-event rules): a string literal in one of the
+  schema's namespaces (``health/<k>``, ``<k>_p50/_p90/_p99``) that is
+  NOT a registered key, or an f-string ASSEMBLING such a key outside
+  ``metrics.py``, forks the key corpus the run stats / bench JSON /
+  flight-recorder rows render from. Reading registered keys is free --
+  only unregistered lookalikes and out-of-home construction are
+  violations; a reasoned allowlist (staleness-checked) covers the one
+  producer that cannot import the registry.
 * ``citation`` -- every top-level module (and subpackage) cites the
   reference ``file:line`` span it covers, with a reasoned allowlist
   for TPU-native-only modules (folded in from the former standalone
@@ -496,6 +506,119 @@ def rule_trace_event_emission(sources: List[_Source]
   return out
 
 
+# -- rule: metric-key-literal ------------------------------------------------
+
+_METRICS_HOME = "kf_benchmarks_tpu/metrics.py"
+# Schema-registration helper names in the home (the first literal arg
+# of each call IS a registered key); parsed from the AST so this lint
+# stays pure stdlib (importing metrics.py as a package module would
+# pull jax via the package __init__).
+_METRIC_REGISTER_FUNCS = {"_register", "_gauge", "_counter", "_hist",
+                          "_info"}
+# The key namespaces the schema owns: a whole-string literal matching
+# one of these is a metric key by construction.
+_METRIC_KEY_PATTERNS = (
+    re.compile(r"health/\w+"),
+    re.compile(r"\w+_p(?:50|90|99)"),
+)
+
+
+def _is_metric_key_fragment(s: str) -> bool:
+  """A string FRAGMENT that assembles a schema-namespace key when
+  joined with other pieces (f-string parts, '+'-concatenation
+  operands): the health/ prefix, or a percentile suffix -- bare
+  (``"_p" + q``) or literal (``f"{key}_p50"``)."""
+  return ("health/" in s or s.endswith("_p")
+          or bool(re.search(r"_p(?:50|90|99)$", s)))
+
+METRIC_KEY_ALLOWLIST = {
+    "kf_benchmarks_tpu/tracing.py":
+        "percentile_fields builds <key>_p<q> over SAMPLE_KEYS x "
+        "QUANTILES -- the one producer that cannot import the registry "
+        "(tracing.py must stay loadable standalone, and the package "
+        "import would pull jax); metrics.schema_audit cross-checks "
+        "every rendered key against the schema instead",
+}
+
+
+def _registered_metric_keys(sources: List[_Source]):
+  """(keys, found_home): literal first args of the schema-registration
+  calls in metrics.py."""
+  keys = set()
+  src = next((s for s in sources if s.path == _METRICS_HOME), None)
+  if src is None or src.tree is None:
+    return keys, False
+  for node in ast.walk(src.tree):
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+        and node.func.id in _METRIC_REGISTER_FUNCS and node.args
+        and isinstance(node.args[0], ast.Constant)
+        and isinstance(node.args[0].value, str)):
+      keys.add(node.args[0].value)
+  return keys, True
+
+
+def rule_metric_key_literal(sources: List[_Source]) -> List[LintViolation]:
+  keys, found_home = _registered_metric_keys(sources)
+  out, hits = [], set()
+  for src in sources:
+    if not (src.path.startswith("kf_benchmarks_tpu/")
+            or src.path == "bench.py"):
+      continue
+    if src.path == _METRICS_HOME or src.tree is None:
+      continue
+    # String constants that sit inside an ASSEMBLY expression are
+    # judged as fragments there, not as whole-key literals here.
+    assembled_constants = set()
+    for node in ast.walk(src.tree):
+      if isinstance(node, ast.JoinedStr):
+        for v in node.values:
+          if isinstance(v, ast.Constant):
+            assembled_constants.add(id(v))
+      elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        for side in (node.left, node.right):
+          if isinstance(side, ast.Constant):
+            assembled_constants.add(id(side))
+    findings = []
+    for node in ast.walk(src.tree):
+      if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+          and id(node) not in assembled_constants:
+        if any(p.fullmatch(node.value) for p in _METRIC_KEY_PATTERNS) \
+            and node.value not in keys:
+          findings.append((node.lineno,
+                           f"metric-key literal {node.value!r} is not "
+                           "registered in the metrics.py schema"))
+      elif isinstance(node, ast.JoinedStr):
+        parts = [v.value for v in node.values
+                 if isinstance(v, ast.Constant)
+                 and isinstance(v.value, str)]
+        if any(_is_metric_key_fragment(p) for p in parts):
+          findings.append((node.lineno,
+                           "metric key assembled by f-string"))
+      elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        sides = [s.value for s in (node.left, node.right)
+                 if isinstance(s, ast.Constant)
+                 and isinstance(s.value, str)]
+        if any(_is_metric_key_fragment(s) for s in sides):
+          findings.append((node.lineno,
+                           "metric key assembled by concatenation"))
+    for lineno, what in findings:
+      hits.add(src.path)
+      if src.path in METRIC_KEY_ALLOWLIST:
+        continue
+      msg = (f"{what} outside {_METRICS_HOME}: metric keys are "
+             "single-sourced in the registry schema (register the key "
+             "there, or build it through its helpers -- "
+             "metrics.health_key / the registered percentile fields)")
+      if not found_home:
+        msg = (f"{what}: no {_METRICS_HOME} schema found to check "
+               "against (package moved?)")
+      out.append(LintViolation("metric-key-literal", src.path, lineno,
+                               msg))
+  out += _stale_allowlist("metric-key-literal", METRIC_KEY_ALLOWLIST,
+                          hits, {s.path for s in sources})
+  return out
+
+
 # -- rule: flag-validation ---------------------------------------------------
 
 def _registry_flags(src: _Source) -> List[str]:
@@ -630,6 +753,7 @@ RULES = {
     "signal-chain": rule_signal_chain,
     "step-line-format": rule_step_line_format,
     "trace-event-emission": rule_trace_event_emission,
+    "metric-key-literal": rule_metric_key_literal,
     "flag-validation": rule_flag_validation,
     "citation": rule_citation,
 }

@@ -24,6 +24,7 @@ from kf_benchmarks_tpu import cluster as cluster_lib
 from kf_benchmarks_tpu import elastic as elastic_lib
 from kf_benchmarks_tpu import faults as faults_lib
 from kf_benchmarks_tpu import learning_rate
+from kf_benchmarks_tpu import metrics as metrics_lib
 from kf_benchmarks_tpu import observability
 from kf_benchmarks_tpu import optimizers
 from kf_benchmarks_tpu import telemetry as telemetry_lib
@@ -750,6 +751,31 @@ class BenchmarkCNN:
         run_id=run_id, chrome_format=bool(p.use_chrome_trace_format),
         log_fn=log_fn)
     tracing_lib.activate(self._trace)
+    # Metric-registry session (metrics.py): always created -- the
+    # registry is the single render source for run stats and the run
+    # record -- with the scrape endpoint bound only when --metrics_port
+    # asks for it (per-rank offset under kfrun: rank r serves
+    # port + r). Host-side only, like the trace session: the metrics-on
+    # step program is structurally identical to the metrics-off golden
+    # (analysis/audit.rule_metrics_twin).
+    self._registry = metrics_lib.MetricRegistry()
+    metrics_lib.activate(self._registry)
+    self._registry.set("run_id", run_id)
+    self._metrics_server = None
+    if p.metrics_port:
+      port = metrics_lib.resolve_port(p.metrics_port, rank)
+      try:
+        self._metrics_server = metrics_lib.MetricsServer(
+            self._registry, port, healthz_fn=self._healthz_payload)
+        log_fn("metrics endpoint: http://127.0.0.1:%d/metrics"
+               % self._metrics_server.port)
+      except (OSError, OverflowError) as e:
+        # A taken port must not cost the run: train without the scrape
+        # surface, loudly. (OverflowError: a per-rank offset can push
+        # the resolved port past 65535, which bind() rejects with a
+        # non-OSError.)
+        log_fn(f"metrics endpoint: bind to port {port} failed ({e}); "
+               "serving disabled for this run")
     self._compiled_programs = set()
     # Persistent XLA compilation cache (ROADMAP item 3 groundwork),
     # configured BEFORE the first trace: a program shape compiles once
@@ -825,14 +851,31 @@ class BenchmarkCNN:
       stop_input = getattr(self, "_input_stop", None)
       if stop_input is not None:
         stop_input()
-      # Deactivate AFTER the input stop (the feeder worker emits feed
-      # spans until it joins), then export: per-rank span file + the
-      # rank-0 multi-rank merge (tracing.py).
+      # Endpoint down, then registry session: a scrape arriving during
+      # teardown reads the final published snapshot, never a
+      # half-closed server. Deactivate AFTER the input stop (the feeder
+      # worker publishes feed lanes until it joins), then export: the
+      # per-rank span file + the rank-0 multi-rank merge (tracing.py).
+      if self._metrics_server is not None:
+        self._metrics_server.close()
+        self._metrics_server = None
+      metrics_lib.deactivate()
       tracing_lib.deactivate()
       try:
         self._trace.export()
       except Exception as e:  # an export failure must not eat the run
         log_fn(f"trace export failed (non-fatal): {e!r}")
+
+  def _healthz_payload(self) -> Dict[str, Any]:
+    """The /healthz body (metrics.MetricsServer calls this from its
+    serving thread): watchdog + flight-recorder state when a telemetry
+    session is live, a bare liveness ack otherwise. Reads only."""
+    payload: Dict[str, Any] = {"status": "ok",
+                               "run_id": self._trace.run_id}
+    tele = getattr(self, "_telemetry", None)
+    if tele is not None:
+      payload.update(tele.healthz())
+    return payload
 
   def _open_input(self, rng, subset: str, bump: bool = True):
     """Open a fresh input stream, closing the previous one (elastic
@@ -1340,6 +1383,17 @@ class BenchmarkCNN:
         chunk_times.append(done.chunk_interval)
       m = done.metrics
       loss = float(m[p.loss_type_to_report])
+      # Live registry lanes (metrics.py): the /metrics scrape shows the
+      # run's current step/loss/health WHILE it trains. Registered-key
+      # sets only; host dict writes, nothing device-side.
+      registry = metrics_lib.active()
+      registry.set("step", start_step + done.index)
+      registry.set("loss", loss)
+      if "learning_rate" in m:
+        registry.set("learning_rate", float(m["learning_rate"]))
+      for health_name, health_value in \
+          telemetry_lib.health_scalars(m).items():
+        registry.set(health_name, health_value)
       if dispatch_span["id"] is None:
         # Device completion attributed DIFFERENTIALLY: the pipeline's
         # read-arrival interval is the dispatch's real wall (the lag-2
@@ -1386,6 +1440,10 @@ class BenchmarkCNN:
         log_fn(log_util.format_step_line(
             i1, self.batch_size * max(self.num_workers, 1), window, loss,
             top1, top5))
+        registry.set(
+            "step_images_per_sec",
+            self.batch_size * max(self.num_workers, 1) /
+            max(sum(window) / max(len(window), 1), 1e-9))
         if bench_logger is not None:
           # Per-step metric emission (ref: benchmark_cnn.py:847-854).
           window_avg = sum(window) / max(len(window), 1)
@@ -1845,7 +1903,7 @@ class BenchmarkCNN:
     if p.sync_on_finish:
       kungfu.run_barrier()
     # (ref stats dict: benchmark_cnn.py:2383-2391)
-    return {
+    stats = {
         "num_workers": max(self.num_workers, 1),
         "num_steps": num_steps,
         "average_wall_time": average_wall_time,
@@ -1904,6 +1962,30 @@ class BenchmarkCNN:
         "run_id": self._trace.run_id or None,
         "state": state,
     }
+    # Final registry publication (the endpoint serves this snapshot
+    # until teardown) + the run record: one schema-versioned JSONL line
+    # per run in the cross-run store (metrics.py RunStore; rank 0 only
+    # -- the ranks share one store and the record describes the job).
+    metrics_lib.publish_stats(metrics_lib.active(), stats)
+    if p.run_store_dir and cluster_lib.process_rank() == 0:
+      try:
+        from kf_benchmarks_tpu.analysis import baseline as baseline_lib
+        record = metrics_lib.run_record(
+            metric="images_per_sec", value=images_per_sec,
+            unit="images/sec",
+            fingerprint=baseline_lib.config_fingerprint_key(
+                p._asdict(), "train"),
+            run_id=self._trace.run_id,
+            platform=p.device,
+            git_rev=metrics_lib.git_revision(),
+            jax_version=jax.__version__,
+            snapshot=metrics_lib.flatten_stats(stats))
+        store = metrics_lib.RunStore(p.run_store_dir)
+        store.append(record)
+        log_fn("run record appended: %s" % store.path)
+      except (OSError, ValueError) as e:
+        log_fn(f"run record append failed (non-fatal): {e}")
+    return stats
 
   def _eval_once(self, state, eval_step, images, labels,
                  next_batch=None) -> Dict[str, Any]:

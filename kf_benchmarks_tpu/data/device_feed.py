@@ -29,6 +29,7 @@ from typing import Iterator, Optional, Tuple
 import jax
 import numpy as np
 
+from kf_benchmarks_tpu import metrics as metrics_lib
 from kf_benchmarks_tpu import tracing
 from kf_benchmarks_tpu.parallel import mesh as mesh_lib
 
@@ -160,6 +161,14 @@ class DeviceFeeder:
     trace.add_span("feed", "wait", t0_trace, trace.now() - t0_trace,
                    {"queue_depth": depth * self._chunk})
     trace.add_sample("feed_wait", waited)
+    # Live metric lanes (metrics.py active registry; no-op sink when no
+    # endpoint/registry session is active): the /metrics scrape shows
+    # queue depth and the feed-wait distribution WHILE the run feeds,
+    # not just the run-end stats() aggregate.
+    registry = metrics_lib.active()
+    registry.inc("fetches")
+    registry.set("queue_depth", depth * self._chunk)
+    registry.observe("feed_wait_s", waited)
     # Queue depth in BATCH units (the queue itself holds chunks when
     # chunk > 1), so the number reads against prefetch_batches.
     self._depth_sum += depth * self._chunk

@@ -151,6 +151,25 @@ def launch(np_: int, command: List[str], logdir: str = ".",
     # rank-0 trace merge is coherent (tracing.py).
     extra_env = dict(extra_env or {})
     extra_env.setdefault("KF_RUN_ID", tracing.resolve_run_id())
+    # Per-rank scrape targets: a worker command carrying --metrics_port
+    # binds base + rank per process (benchmark.py resolve_port), so the
+    # launcher prints the whole job's endpoint list once up front --
+    # the operator's copy-paste Prometheus targets. Always loopback:
+    # the endpoint binds 127.0.0.1 regardless of the coordinator
+    # --host (kfrun workers share this machine). Both flag spellings
+    # (--metrics_port=P and --metrics_port P) are recognized.
+    metrics_base = None
+    for i, tok in enumerate(command):
+      if tok.startswith("--metrics_port="):
+        metrics_base = tok.partition("=")[2]
+      elif tok == "--metrics_port" and i + 1 < len(command):
+        metrics_base = command[i + 1]
+    if metrics_base and metrics_base.isdigit():
+      targets = ", ".join(
+          f"http://127.0.0.1:{int(metrics_base) + r}/metrics"
+          for r in range(np_))
+      print(f"kfrun: metrics endpoints: {targets}",
+            file=sys.stderr, flush=True)
     for _ in range(max_restarts + 1):
       code, restart = _run_generation(server, gen_np, command, logdir,
                                       host, extra_env,

@@ -1,0 +1,1106 @@
+"""Metrics fabric: unified metric registry, cross-run record store with
+a regression sentinel, and a live ``/metrics`` endpoint.
+
+TPU-native re-design of the reference's result-upload path: the
+reference ships every run's numbers off-host -- tf_cnn_benchmarks'
+BenchmarkLogger writes structured JSON metric/run files an uploader
+ships to BigQuery (ref: benchmark_cnn.py:1594-1608 benchmark_log_dir
+plumbing, logs the same ``average_examples_per_sec`` rows this module
+registers), and the keras_benchmarks project uploads straight to
+BigQuery (SURVEY §0 item 2) -- so results accumulate in a queryable
+store. Here the same capability is host-local and dependency-free,
+with three coupled pieces:
+
+* **MetricRegistry** -- the typed schema (``SCHEMA``) is the single
+  source of every metric key the framework emits: benchmark run stats,
+  bench.py's one-line JSON, telemetry health keys, tracing latency
+  percentiles and DeviceFeeder stats all render from keys registered
+  here. The hazard lint (``analysis/lint.py`` rule
+  ``metric-key-literal``) bans metric-key construction outside this
+  schema; ``schema_audit`` cross-checks the registry against what the
+  emitters actually produce.
+* **Run-record store** -- every run appends ONE schema-versioned JSON
+  line (config fingerprint from
+  ``analysis/baseline.config_fingerprint_key``, git rev, jax version,
+  platform, full metric snapshot) to an append-only JSONL store, with
+  a query/merge API and a noise-aware (MAD-based) **regression
+  sentinel** (``check_regression``). The first real-chip record per
+  fingerprint auto-promotes to baseline, so the queued chip campaign
+  (ROADMAP re-anchor note) self-baselines the moment the tunnel is
+  healthy. ``python -m kf_benchmarks_tpu.metrics backfill`` ingests
+  the committed ``BENCH_r0*.json`` history.
+* **Live endpoint** -- an opt-in stdlib HTTP thread
+  (``--metrics_port``; port + rank under kfrun) serving ``/metrics``
+  in Prometheus text exposition format straight from the registry and
+  ``/healthz`` from watchdog + flight-recorder state. Host-side only:
+  the metrics-on step program is structurally identical to the
+  metrics-off golden (``analysis/audit.rule_metrics_twin``, the
+  twin-trace pattern).
+
+Pure stdlib and host-only. Loadable standalone by file path (the
+``run_tests.py --audit`` metrics-schema leg does exactly that); when
+path-loaded, the percentile math is taken from ``tracing.py`` loaded
+the same way, so the quantile convention stays single-sourced without
+importing the (jax-importing) package.
+"""
+
+from __future__ import annotations
+
+import collections
+import http.server
+import json
+import math
+import os
+import re
+import socketserver
+import threading
+import time
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+if __package__:
+  from kf_benchmarks_tpu import tracing as _tracing
+else:  # loaded by file path (run_tests.py --audit): stay stdlib-only
+  import importlib.util as _ilu
+
+  def _load_tracing():
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tracing.py")
+    spec = _ilu.spec_from_file_location("kf_metrics_tracing", path)
+    mod = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+  _tracing = _load_tracing()
+
+
+# -- schema -------------------------------------------------------------------
+
+class MetricSpec(NamedTuple):
+  name: str
+  kind: str    # "counter" | "gauge" | "histogram" | "info"
+  unit: str
+  help: str
+  source: str  # producing subsystem
+
+
+SCHEMA: "collections.OrderedDict[str, MetricSpec]" = \
+    collections.OrderedDict()
+
+# The in-step health vector's key order (telemetry.health_finalize
+# builds it; telemetry.py re-exports this tuple -- the ONE copy).
+HEALTH_KEYS = ("grad_norm", "update_ratio", "nonfinite_leaves",
+               "loss_scale", "skipped")
+# Run-end health summary keys (FlightRecorder.summary + watchdog).
+HEALTH_SUMMARY_KEYS = ("records", "max_grad_norm", "nonfinite_steps",
+                       "loss_scale_final", "anomaly_dumps",
+                       "watchdog_stalls")
+
+
+def health_key(name: str) -> str:
+  """The ``health/<key>`` namespace -- the ONE place that prefix is
+  constructed (flight-recorder rows, summary scalars and the registry
+  all share it; the metric-key-literal lint bans building it
+  elsewhere)."""
+  return "health/" + name
+
+
+def _register(name: str, kind: str, unit: str, help_: str,
+              source: str) -> str:
+  if name in SCHEMA:
+    raise ValueError(f"duplicate metric key: {name}")
+  SCHEMA[name] = MetricSpec(name, kind, unit, help_, source)
+  return name
+
+
+def _gauge(name, unit, help_, source):
+  return _register(name, "gauge", unit, help_, source)
+
+
+def _counter(name, unit, help_, source):
+  return _register(name, "counter", unit, help_, source)
+
+
+def _hist(name, unit, help_, source):
+  return _register(name, "histogram", unit, help_, source)
+
+
+def _info(name, help_, source):
+  return _register(name, "info", "", help_, source)
+
+
+# Benchmark run stats (benchmark.py _benchmark_train / forward / eval).
+_gauge("images_per_sec", "images/s",
+       "Timed-loop throughput (the headline metric)", "benchmark")
+_gauge("average_wall_time", "s", "Mean wall time per step", "benchmark")
+_gauge("last_average_loss", "1", "Loss of the last completed step",
+       "benchmark")
+_counter("num_steps", "steps", "Timed steps completed", "benchmark")
+_counter("num_chunks", "chunks", "Timed K-step dispatches completed",
+         "benchmark")
+_gauge("num_workers", "processes", "Cooperating worker processes",
+       "benchmark")
+_gauge("steps_per_dispatch", "steps", "K of the chunked dispatch",
+       "benchmark")
+_gauge("compile_s", "s",
+       "Wall of the first dispatch (blocks on trace+compile)",
+       "benchmark")
+_gauge("dispatch_overhead_s", "s",
+       "Mean host time per timed dispatch call (jit call + RTT)",
+       "benchmark")
+_gauge("grad_noise_scale", "1", "EMA-smoothed B_simple estimate",
+       "benchmark")
+_gauge("opt_state_bytes_per_device", "bytes",
+       "Per-device optimizer-state HBM", "benchmark")
+_gauge("param_bytes_per_device", "bytes", "Per-device parameter HBM",
+       "benchmark")
+_gauge("feed_stall_fraction", "1",
+       "Fraction of the consume window blocked on the host feed",
+       "feeder")
+_gauge("packing_efficiency", "1",
+       "Real-token fraction of the packed (B, T) grid", "feeder")
+_gauge("eval_images_per_sec", "images/s", "Eval-loop throughput",
+       "benchmark")
+_gauge("top_1_accuracy", "1", "Eval top-1 accuracy", "benchmark")
+_gauge("top_5_accuracy", "1", "Eval top-5 accuracy", "benchmark")
+
+# Live training-loop gauges (the /metrics endpoint's per-step surface).
+_counter("step", "steps", "Last completed global step", "benchmark")
+_gauge("loss", "1", "Loss at the last completed step", "benchmark")
+_gauge("learning_rate", "1", "Learning rate at the last completed step",
+       "benchmark")
+_gauge("step_images_per_sec", "images/s",
+       "Throughput over the last display window", "benchmark")
+
+# Telemetry (telemetry.py): in-step health vector + run-end summary,
+# all under the health/ namespace (health_key).
+_gauge("health/grad_norm", "1", "Global gradient norm (in-step)",
+       "telemetry")
+_gauge("health/update_ratio", "1",
+       "Update/param norm ratio (in-step)", "telemetry")
+_gauge("health/nonfinite_leaves", "leaves",
+       "Non-finite gradient leaves (in-step)", "telemetry")
+_gauge("health/loss_scale", "1", "Loss scale (in-step)", "telemetry")
+_gauge("health/skipped", "1", "Step skipped by the loss-scale machine",
+       "telemetry")
+_counter("health/records", "records", "Flight-recorder rows retained",
+         "telemetry")
+_gauge("health/max_grad_norm", "1", "Max global grad norm seen",
+       "telemetry")
+_counter("health/nonfinite_steps", "steps",
+         "Steps with a non-finite training signal", "telemetry")
+_gauge("health/loss_scale_final", "1", "Final loss scale", "telemetry")
+_counter("health/anomaly_dumps", "dumps",
+         "Flight-recorder anomaly episodes dumped", "telemetry")
+_counter("health/watchdog_stalls", "stalls",
+         "Stall-watchdog diagnostic episodes", "telemetry")
+
+# Tracing (tracing.py): streaming latency percentiles over
+# tracing.SAMPLE_KEYS x tracing.QUANTILES (schema_audit cross-checks
+# this block against those tuples so the two cannot drift) + the
+# compile-ledger aggregates.
+_gauge("chunk_wall_p50", "s", "Chunk wall p50", "tracing")
+_gauge("chunk_wall_p90", "s", "Chunk wall p90", "tracing")
+_gauge("chunk_wall_p99", "s", "Chunk wall p99", "tracing")
+_gauge("feed_wait_p50", "s", "Feed wait p50", "tracing")
+_gauge("feed_wait_p90", "s", "Feed wait p90", "tracing")
+_gauge("feed_wait_p99", "s", "Feed wait p99", "tracing")
+_gauge("checkpoint_save_p50", "s", "Checkpoint save p50", "tracing")
+_gauge("checkpoint_save_p90", "s", "Checkpoint save p90", "tracing")
+_gauge("checkpoint_save_p99", "s", "Checkpoint save p99", "tracing")
+_counter("compile_ledger/shapes", "programs",
+         "Distinct program shapes compiled", "tracing")
+_counter("compile_ledger/total_compile_s", "s",
+         "Total compile wall seconds", "tracing")
+
+# DeviceFeeder (data/device_feed.py): run-end stats + live lanes.
+_counter("fetches", "batches", "Batches delivered to the consumer",
+         "feeder")
+_gauge("consumer_wait_s", "s", "Total consumer blocked-wait time",
+       "feeder")
+_gauge("window_s", "s", "Wall window spanning the fetches", "feeder")
+_gauge("queue_depth", "batches", "Prefetch queue depth at last fetch",
+       "feeder")
+_gauge("queue_depth_mean", "batches", "Mean queue depth at fetch time",
+       "feeder")
+_gauge("queue_depth_max", "batches", "Max queue depth at fetch time",
+       "feeder")
+_gauge("prefetch_batches", "batches", "Configured prefetch depth",
+       "feeder")
+_hist("feed_wait_s", "s", "Per-fetch consumer blocked-wait", "feeder")
+
+# bench.py's one-line JSON (fields not covered above).
+_gauge("vs_baseline", "1",
+       "Headline value over the reference's committed baseline",
+       "bench")
+_gauge("retries", "probes", "TPU probe attempts beyond the first",
+       "bench")
+_info("mesh_shape", "Mesh topology the run executed on", "benchmark")
+_info("run_id", "Run id shared with trace + flight recorder",
+      "benchmark")
+_info("git_rev", "Git revision the run was built from", "bench")
+_info("platform", "Execution platform (tpu | cpu)", "bench")
+_info("metric", "Headline metric name", "bench")
+_info("unit", "Headline metric unit", "bench")
+
+# Run-stats / bench-JSON keys that are bookkeeping, not metrics: the
+# schema audit accepts them from the emitters without registration.
+NON_METRIC_KEYS = frozenset({
+    "state", "stopped_early", "restart_for_resize", "reshape_events",
+    "aot_load_path", "value", "entries", "health",
+    "latency_percentiles", "compile_ledger",
+})
+
+_PROM_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def prometheus_name(key: str) -> str:
+  return "kf_" + _PROM_NAME_RE.sub("_", key)
+
+
+# -- registry -----------------------------------------------------------------
+
+_HIST_MAX_SAMPLES = 4096
+
+
+class MetricRegistry:
+  """Typed, thread-safe value store over the SCHEMA.
+
+  Producers set/inc/observe REGISTERED keys only -- an unknown key
+  raises, which is the runtime half of the single-source contract (the
+  lint rule is the static half). Purely host-side: no jax, no device
+  work, cheap enough to update per completed step.
+  """
+
+  def __init__(self):
+    self._lock = threading.Lock()
+    self._values: Dict[str, float] = {}
+    self._info: Dict[str, str] = {}
+    # histogram key -> [count, sum, samples, stride]
+    self._hists: Dict[str, list] = {}
+
+  @staticmethod
+  def _spec(name: str) -> MetricSpec:
+    spec = SCHEMA.get(name)
+    if spec is None:
+      raise ValueError(
+          f"unregistered metric key {name!r}: register it in "
+          "kf_benchmarks_tpu/metrics.py SCHEMA (the single source of "
+          "metric keys; see the metric-key-literal lint rule)")
+    return spec
+
+  def set(self, name: str, value) -> None:
+    spec = self._spec(name)
+    with self._lock:
+      if spec.kind == "info":
+        self._info[name] = str(value)
+      elif spec.kind == "histogram":
+        raise ValueError(f"{name} is a histogram; use observe()")
+      else:
+        self._values[name] = float(value)
+
+  def inc(self, name: str, delta: float = 1.0) -> None:
+    spec = self._spec(name)
+    if spec.kind != "counter":
+      raise ValueError(f"{name} is a {spec.kind}; inc() is counter-only")
+    with self._lock:
+      self._values[name] = self._values.get(name, 0.0) + float(delta)
+
+  def observe(self, name: str, value: float) -> None:
+    spec = self._spec(name)
+    if spec.kind != "histogram":
+      raise ValueError(f"{name} is a {spec.kind}; observe() is "
+                       "histogram-only")
+    with self._lock:
+      row = self._hists.setdefault(name, [0, 0.0, [], 1])
+      row[0] += 1
+      row[1] += float(value)
+      if (row[0] - 1) % row[3] == 0:
+        row[2].append(float(value))
+        if len(row[2]) >= _HIST_MAX_SAMPLES:
+          # The tracing.add_sample discipline: deterministic 2:1
+          # decimation + stride doubling bounds memory on long runs.
+          row[2] = row[2][::2]
+          row[3] *= 2
+
+  def snapshot(self) -> Dict[str, Any]:
+    """Flat {key: value} of every set scalar/info value (histograms
+    summarize to their quantile keys is the renderer's job; here they
+    surface as <name>/count and <name>/sum for the run record)."""
+    with self._lock:
+      out: Dict[str, Any] = dict(self._values)
+      out.update(self._info)
+      hists = {k: (row[0], row[1]) for k, row in self._hists.items()}
+    for k, (count, total) in hists.items():
+      out[k + "/count"] = count
+      out[k + "/sum"] = total
+    return out
+
+  def render(self) -> str:
+    """Prometheus text exposition format (version 0.0.4), straight
+    from the registry. Info-kind values collapse into one
+    ``kf_run_info`` labeled gauge (the Prometheus info-metric idiom)."""
+    with self._lock:
+      values = dict(self._values)
+      info = dict(self._info)
+      hists = {k: (row[0], row[1], list(row[2]))
+               for k, row in self._hists.items()}
+    lines: List[str] = []
+    for name, value in sorted(values.items()):
+      spec = SCHEMA[name]
+      prom = prometheus_name(name)
+      lines.append(f"# HELP {prom} {spec.help} [{spec.unit}]")
+      lines.append(f"# TYPE {prom} {spec.kind}")
+      lines.append(f"{prom} {_fmt_value(value)}")
+    for name, (count, total, samples) in sorted(hists.items()):
+      spec = SCHEMA[name]
+      prom = prometheus_name(name)
+      lines.append(f"# HELP {prom} {spec.help} [{spec.unit}]")
+      lines.append(f"# TYPE {prom} summary")
+      for q in _tracing.QUANTILES:
+        v = _tracing.percentile(samples, q)
+        if v is not None:
+          lines.append('%s{quantile="0.%02d"} %s'
+                       % (prom, q, _fmt_value(v)))
+      lines.append(f"{prom}_sum {_fmt_value(total)}")
+      lines.append(f"{prom}_count {count}")
+    if info:
+      labels = ",".join(
+          f'{_PROM_NAME_RE.sub("_", k)}="{_escape_label(v)}"'
+          for k, v in sorted(info.items()))
+      lines.append("# HELP kf_run_info Run identity labels")
+      lines.append("# TYPE kf_run_info gauge")
+      lines.append("kf_run_info{%s} 1" % labels)
+    return "\n".join(lines) + "\n"
+
+
+def _fmt_value(v: float) -> str:
+  if math.isnan(v):
+    return "NaN"
+  if math.isinf(v):
+    return "+Inf" if v > 0 else "-Inf"
+  return format(float(v), ".10g")
+
+
+def _escape_label(v: str) -> str:
+  return str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
+      "\n", "\\n")
+
+
+_PROM_LINE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? "
+    r"(NaN|[+-]Inf|[-+0-9.eE]+)$")
+
+
+def validate_prometheus_text(text: str) -> List[str]:
+  """Structural check of a Prometheus text-format payload; returns
+  problem strings (empty = valid). The schema contract the endpoint
+  tests and the metrics-schema audit pin."""
+  problems = []
+  for i, line in enumerate(text.splitlines()):
+    if not line.strip():
+      continue
+    if line.startswith("# TYPE "):
+      parts = line.split()
+      if len(parts) != 4 or parts[3] not in (
+          "counter", "gauge", "summary", "histogram", "untyped"):
+        problems.append(f"line {i}: bad TYPE line {line!r}")
+      continue
+    if line.startswith("#"):
+      continue
+    if not _PROM_LINE_RE.match(line):
+      problems.append(f"line {i}: not a metric sample: {line!r}")
+  return problems
+
+
+# -- stats flattening (run stats / bench JSON -> registered keys) -------------
+
+def flatten_stats(stats: Dict[str, Any]) -> Dict[str, Any]:
+  """One flat {registered key: value} view of a benchmark stats dict or
+  a bench.py JSON record: nested health / latency_percentiles /
+  compile_ledger containers expand onto their registered keys,
+  bookkeeping keys (NON_METRIC_KEYS) and unset values drop out."""
+  out: Dict[str, Any] = {}
+  for key, value in (stats or {}).items():
+    if value is None:
+      continue
+    if key == "health" and isinstance(value, dict):
+      for hk, hv in value.items():
+        name = health_key(hk)
+        if name in SCHEMA and isinstance(hv, (int, float)):
+          out[name] = float(hv)
+      continue
+    if key == "latency_percentiles" and isinstance(value, dict):
+      for lk, lv in value.items():
+        if lk in SCHEMA and lv is not None:
+          out[lk] = float(lv)
+      continue
+    if key == "compile_ledger" and isinstance(value, dict):
+      for ck in ("shapes", "total_compile_s"):
+        if value.get(ck) is not None:
+          out["compile_ledger/" + ck] = float(value[ck])
+      continue
+    spec = SCHEMA.get(key)
+    if spec is None:
+      continue
+    if spec.kind == "info":
+      out[key] = str(value)
+    elif isinstance(value, bool):
+      out[key] = float(value)
+    elif isinstance(value, (int, float)):
+      out[key] = float(value)
+  return out
+
+
+def publish_stats(registry, stats: Dict[str, Any]) -> None:
+  """Render a stats dict into a registry (the run-end publication the
+  /metrics endpoint serves after the loop completes)."""
+  for key, value in flatten_stats(stats).items():
+    if SCHEMA[key].kind == "histogram":
+      continue
+    registry.set(key, value)
+
+
+# -- active-registry (the tracing.py pattern) ---------------------------------
+
+class _NullRegistry:
+  """No-op sink with the MetricRegistry surface, so deep producers
+  (DeviceFeeder's consumer path) publish unconditionally."""
+
+  def set(self, *a, **k) -> None:
+    pass
+
+  def inc(self, *a, **k) -> None:
+    pass
+
+  def observe(self, *a, **k) -> None:
+    pass
+
+  def snapshot(self) -> Dict[str, Any]:
+    return {}
+
+  def render(self) -> str:
+    return "\n"
+
+
+NULL_REGISTRY = _NullRegistry()
+_active: Any = None
+
+
+def activate(registry: MetricRegistry) -> MetricRegistry:
+  global _active
+  _active = registry
+  return registry
+
+
+def deactivate() -> None:
+  global _active
+  _active = None
+
+
+def active():
+  """The process's active MetricRegistry, or the no-op sink."""
+  return _active if _active is not None else NULL_REGISTRY
+
+
+# -- live endpoint ------------------------------------------------------------
+
+def resolve_port(base_port: int, rank: int = 0) -> int:
+  """Per-rank port under kfrun: rank r serves base + r (every worker of
+  a single-host job gets its own scrape target)."""
+  return int(base_port) + int(rank)
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+  server_version = "kf-metrics/1"
+
+  def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler API)
+    path = self.path.split("?", 1)[0]
+    if path == "/metrics":
+      body = self.server.registry.render().encode("utf-8")
+      ctype = "text/plain; version=0.0.4; charset=utf-8"
+    elif path == "/healthz":
+      try:
+        payload = self.server.healthz_fn()
+      except Exception as e:  # a health probe must answer, not raise
+        payload = {"status": "error", "error": repr(e)}
+      body = (json.dumps(payload) + "\n").encode("utf-8")
+      ctype = "application/json"
+    else:
+      self.send_error(404, "unknown path (serving /metrics, /healthz)")
+      return
+    self.send_response(200)
+    self.send_header("Content-Type", ctype)
+    self.send_header("Content-Length", str(len(body)))
+    self.end_headers()
+    self.wfile.write(body)
+
+  def log_message(self, *args) -> None:
+    pass  # scrapes must never interleave into the run's stdout
+
+
+class _Server(socketserver.ThreadingMixIn, http.server.HTTPServer):
+  daemon_threads = True
+  # Scrape targets restart with the run; a lingering TIME_WAIT socket
+  # must not fail the next run's bind.
+  allow_reuse_address = True
+
+
+class MetricsServer:
+  """Opt-in scrape endpoint on a daemon thread.
+
+  Binds eagerly (a bad port fails fast at session start, not at first
+  scrape); ``port=0`` binds an ephemeral port -- ``self.port`` is
+  always the real bound port. Host-side only by construction: the
+  handler reads the registry under its lock and never touches jax.
+  """
+
+  def __init__(self, registry, port: int, host: str = "127.0.0.1",
+               healthz_fn: Optional[Callable[[], Dict[str, Any]]] = None):
+    self._httpd = _Server((host, int(port)), _Handler)
+    self._httpd.registry = registry
+    self._httpd.healthz_fn = healthz_fn or (lambda: {"status": "ok"})
+    self.host = host
+    self.port = int(self._httpd.server_address[1])
+    self._thread = threading.Thread(
+        target=self._httpd.serve_forever, name="kf-metrics-endpoint",
+        daemon=True)
+    self._thread.start()
+
+  def close(self) -> None:
+    self._httpd.shutdown()
+    self._httpd.server_close()
+    self._thread.join(timeout=5.0)
+
+
+# -- run-record store ---------------------------------------------------------
+
+RECORD_SCHEMA_VERSION = 1
+STORE_FILENAME = "run_store.jsonl"
+
+
+def run_record(*, metric: str, value: float, unit: str,
+               fingerprint: str, run_id: str, platform: str,
+               fallback: bool = False, git_rev: Optional[str] = None,
+               jax_version: Optional[str] = None,
+               snapshot: Optional[Dict[str, Any]] = None,
+               t_wall: Optional[float] = None) -> Dict[str, Any]:
+  """One schema-versioned run record. ``fingerprint`` is the program
+  identity (analysis/baseline.config_fingerprint_key) the sentinel
+  compares within; ``fallback`` marks a ``_CPU_FALLBACK`` probe so it
+  can never enter a chip baseline; ``snapshot`` is the flat registered
+  metric view (flatten_stats / MetricRegistry.snapshot)."""
+  return {
+      "schema_version": RECORD_SCHEMA_VERSION,
+      "t_wall": round(float(time.time() if t_wall is None else t_wall),
+                      3),
+      "run_id": str(run_id),
+      "fingerprint": str(fingerprint),
+      "metric": str(metric),
+      "value": float(value),
+      "unit": str(unit),
+      "platform": str(platform),
+      "fallback": bool(fallback),
+      "baseline": False,
+      "git_rev": git_rev,
+      "jax_version": jax_version,
+      "snapshot": dict(snapshot or {}),
+  }
+
+
+def validate_record(rec) -> List[str]:
+  """Problem strings (empty = valid) for one store record -- the
+  schema-version contract the metrics-schema audit re-checks over the
+  whole store."""
+  problems = []
+  if not isinstance(rec, dict):
+    return ["record is not an object"]
+  ver = rec.get("schema_version")
+  if not isinstance(ver, int) or not 1 <= ver <= RECORD_SCHEMA_VERSION:
+    problems.append(f"schema_version {ver!r} outside "
+                    f"[1, {RECORD_SCHEMA_VERSION}]")
+  for field in ("run_id", "fingerprint", "metric", "unit", "platform"):
+    v = rec.get(field)
+    if not isinstance(v, str) or not v:
+      problems.append(f"{field} missing or not a non-empty string")
+  v = rec.get("value")
+  if not isinstance(v, (int, float)) or isinstance(v, bool) or \
+      not math.isfinite(v):
+    problems.append(f"value {v!r} is not a finite number")
+  if not isinstance(rec.get("t_wall"), (int, float)):
+    problems.append("t_wall missing or not a number")
+  for field in ("fallback", "baseline"):
+    if not isinstance(rec.get(field), bool):
+      problems.append(f"{field} missing or not a bool")
+  snap = rec.get("snapshot")
+  if not isinstance(snap, dict):
+    problems.append("snapshot missing or not an object")
+  else:
+    for k, sv in snap.items():
+      if k.split("/count")[0].split("/sum")[0] not in SCHEMA:
+        problems.append(f"snapshot key {k!r} not in the metric schema")
+      elif not isinstance(sv, (int, float, str)):
+        problems.append(f"snapshot value for {k!r} is {type(sv).__name__}")
+  return problems
+
+
+class RunStore:
+  """Append-only JSONL store of run records.
+
+  One line per run; torn/foreign lines are skipped on read (the store
+  rides ordinary filesystems and a crashed writer must not poison the
+  history). ``append`` validates and auto-promotes the first real-chip
+  record of a fingerprint to baseline.
+  """
+
+  def __init__(self, store_dir: str, filename: str = STORE_FILENAME):
+    self.dir = str(store_dir)
+    self.path = os.path.join(self.dir, filename)
+
+  def records(self) -> List[Dict[str, Any]]:
+    out = []
+    try:
+      with open(self.path, encoding="utf-8") as f:
+        for line in f:
+          line = line.strip()
+          if not line:
+            continue
+          try:
+            rec = json.loads(line)
+          except ValueError:
+            continue
+          if isinstance(rec, dict) and "metric" in rec:
+            out.append(rec)
+    except OSError:
+      pass
+    return out
+
+  def query(self, fingerprint: Optional[str] = None,
+            metric: Optional[str] = None,
+            fallback: Optional[bool] = None) -> List[Dict[str, Any]]:
+    rows = self.records()
+    if fingerprint is not None:
+      rows = [r for r in rows if r.get("fingerprint") == fingerprint]
+    if metric is not None:
+      rows = [r for r in rows if r.get("metric") == metric]
+    if fallback is not None:
+      rows = [r for r in rows if bool(r.get("fallback")) == fallback]
+    rows.sort(key=lambda r: r.get("t_wall", 0.0))
+    return rows
+
+  def has_run(self, run_id: str, metric: str) -> bool:
+    return any(r.get("run_id") == run_id and r.get("metric") == metric
+               for r in self.records())
+
+  def append(self, rec: Dict[str, Any]) -> Dict[str, Any]:
+    problems = validate_record(rec)
+    if problems:
+      raise ValueError("invalid run record: " + "; ".join(problems))
+    if rec["platform"] == "tpu" and not rec["fallback"] and \
+        not rec["baseline"]:
+      # Baseline self-promotion: the FIRST real-chip record per
+      # fingerprint becomes the baseline, so the reserved chip campaign
+      # baselines itself the moment the tunnel is healthy. _CPU_FALLBACK
+      # rows (fallback=True) and CPU runs are never eligible.
+      prior = [r for r in self.records()
+               if r.get("fingerprint") == rec["fingerprint"]
+               and r.get("baseline")]
+      if not prior:
+        rec = dict(rec, baseline=True)
+    os.makedirs(self.dir, exist_ok=True)
+    with open(self.path, "a", encoding="utf-8") as f:
+      f.write(json.dumps(rec, sort_keys=True) + "\n")
+    return rec
+
+  @staticmethod
+  def merge(paths: List[str]) -> List[Dict[str, Any]]:
+    """Union of several store files, deduped on (run_id, metric,
+    t_wall) -- the cross-host merge for stores synced from more than
+    one machine."""
+    seen = set()
+    out = []
+    for path in paths:
+      for rec in RunStore(os.path.dirname(path) or ".",
+                          os.path.basename(path)).records():
+        key = (rec.get("run_id"), rec.get("metric"), rec.get("t_wall"))
+        if key in seen:
+          continue
+        seen.add(key)
+        out.append(rec)
+    out.sort(key=lambda r: r.get("t_wall", 0.0))
+    return out
+
+
+# -- regression sentinel ------------------------------------------------------
+
+# Consistent MAD->sigma factor for normal noise.
+MAD_SIGMA = 1.4826
+# Defaults tuned to the acceptance bar: a seeded 20% throughput drop
+# flags against any realistic history, +-5% run-to-run noise stays
+# quiet (uniform +-5% noise has MAD ~2.5%, so the MAD leg of the bar
+# sits at ~15%; a noise-free history floors the bar at rel_floor).
+SENTINEL_WINDOW = 8
+SENTINEL_MAD_FACTOR = 4.0
+SENTINEL_REL_FLOOR = 0.08
+SENTINEL_MIN_HISTORY = 3
+
+
+def check_regression(history: List[Dict[str, Any]],
+                     fresh: Dict[str, Any],
+                     window: int = SENTINEL_WINDOW,
+                     mad_factor: float = SENTINEL_MAD_FACTOR,
+                     rel_floor: float = SENTINEL_REL_FLOOR,
+                     min_history: int = SENTINEL_MIN_HISTORY,
+                     higher_is_better: bool = True) -> Dict[str, Any]:
+  """Compare ``fresh`` against the trailing median of comparable
+  history with a noise-aware bar.
+
+  Comparable = same fingerprint, same metric name, same fallback
+  status (a ``_CPU_FALLBACK`` probe never judges -- or joins -- a chip
+  baseline), excluding the fresh run itself. The bar is
+  ``max(mad_factor * 1.4826 * MAD, rel_floor * |median|)``: the MAD leg
+  adapts to the config's measured run-to-run noise, the relative floor
+  keeps a noise-free history from flagging epsilon jitter.
+  """
+  rows = [r for r in history
+          if r.get("fingerprint") == fresh.get("fingerprint")
+          and r.get("metric") == fresh.get("metric")
+          and bool(r.get("fallback")) == bool(fresh.get("fallback"))
+          and r.get("run_id") != fresh.get("run_id")]
+  rows.sort(key=lambda r: r.get("t_wall", 0.0))
+  tail = rows[-max(1, int(window)):]
+  value = float(fresh.get("value", float("nan")))
+  base = {
+      "metric": fresh.get("metric"),
+      "fingerprint": fresh.get("fingerprint"),
+      "value": value,
+      "n": len(tail),
+      "window": int(window),
+  }
+  if len(tail) < min_history:
+    return dict(base, status="no_history", median=None, bar=None)
+  vals = [float(r["value"]) for r in tail]
+  med = _tracing.percentile(vals, 50)
+  mad = _tracing.percentile([abs(v - med) for v in vals], 50)
+  bar = max(mad_factor * MAD_SIGMA * mad, rel_floor * abs(med))
+  delta = (med - value) if higher_is_better else (value - med)
+  status = "regression" if delta > bar else "ok"
+  return dict(base, status=status, median=med, bar=bar)
+
+
+def verdict_line(verdict: Dict[str, Any]) -> str:
+  """One whole self-identifying verdict line (the scrape-guard
+  discipline: never interleaves inside the bench JSON line)."""
+  metric = verdict.get("metric")
+  fp = (verdict.get("fingerprint") or "")[:16]
+  if verdict["status"] == "no_history":
+    return (f"regression check: NO HISTORY for {metric} "
+            f"(fingerprint {fp}, {verdict['n']} comparable record(s)); "
+            "recorded as history for future runs")
+  word = "REGRESSION" if verdict["status"] == "regression" else "OK"
+  return ("regression check: %s %s value=%.3f median=%.3f bar=%.3f "
+          "(n=%d, fingerprint %s)" % (
+              word, metric, verdict["value"], verdict["median"],
+              verdict["bar"], verdict["n"], fp))
+
+
+# -- bench identity (shared by bench.py and the backfill CLI) -----------------
+
+def bench_params_kwargs(on_tpu: bool) -> Dict[str, Any]:
+  """The canonical headline-bench config (bench.py's make_params call)
+  -- ONE copy, so a backfilled record and a fresh bench run compute the
+  same config fingerprint."""
+  return dict(
+      model="resnet50",
+      batch_size=256 if on_tpu else 8,
+      num_batches=None if on_tpu else 5,
+      num_warmup_batches=None if on_tpu else 1,
+      device="tpu" if on_tpu else "cpu",
+      num_devices=1,
+      variable_update="replicated",
+      use_fp16=on_tpu,
+      optimizer="momentum",
+      display_every=10,
+      health_stats=True,
+  )
+
+
+def bench_fingerprint(on_tpu: bool) -> str:
+  """Config fingerprint of the headline bench (program name "bench").
+
+  Imports the params registry lazily (jax-adjacent); when that import
+  is unavailable (path-loaded stdlib context) the key degrades to a
+  stable legacy tag so backfill still produces comparable history."""
+  try:
+    from kf_benchmarks_tpu import params as params_lib
+    from kf_benchmarks_tpu.analysis import baseline as baseline_lib
+  except ImportError:  # the designed degrade: no package/jax available
+    return "bench-legacy-" + ("tpu" if on_tpu else "cpu")
+  params = params_lib.make_params(**bench_params_kwargs(on_tpu))
+  return baseline_lib.config_fingerprint_key(params._asdict(), "bench")
+
+
+def git_revision(repo_dir: Optional[str] = None) -> Optional[str]:
+  """Short git revision of ``repo_dir`` (default: this repo), or None
+  when git/metadata is unavailable -- a missing rev must never fail a
+  bench run."""
+  import subprocess
+  cwd = repo_dir or os.path.dirname(os.path.dirname(
+      os.path.abspath(__file__)))
+  try:
+    out = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                         capture_output=True, text=True, cwd=cwd,
+                         timeout=10)
+  except (OSError, subprocess.SubprocessError):
+    return None
+  rev = (out.stdout or "").strip()
+  return rev if out.returncode == 0 and rev else None
+
+
+# -- backfill -----------------------------------------------------------------
+
+def bench_rows(path: str) -> List[Dict[str, Any]]:
+  """The bench record(s) inside one ``BENCH_*.json`` artifact.
+
+  Two committed shapes: the driver wrapper (one pretty-printed object
+  whose ``parsed`` field holds bench.py's one-line record -- the
+  ``BENCH_r0*.json`` history) and raw bench JSONL (one record per
+  line). Anything else yields nothing."""
+  try:
+    text = open(path, encoding="utf-8").read()
+  except OSError:
+    return []
+  try:
+    obj = json.loads(text)
+  except ValueError:
+    obj = None
+  if isinstance(obj, dict):
+    row = obj.get("parsed") if isinstance(obj.get("parsed"),
+                                          dict) else obj
+    return [row] if "metric" in row else []
+  out = []
+  for line in text.splitlines():
+    line = line.strip()
+    if not line:
+      continue
+    try:
+      row = json.loads(line)
+    except ValueError:
+      continue
+    if isinstance(row, dict) and "metric" in row:
+      out.append(row)
+  return out
+
+
+def _backfill_ordinal(name: str, line: int) -> int:
+  """Synthetic t_wall for a backfilled row: historical files carry no
+  timestamp, so the ordinal is derived from the FILE NAME (first 16
+  bytes, big-endian) -- monotone in lexicographic name order and
+  stable under later insertions (a BENCH_r02 committed after r03 was
+  already ingested still sorts between r01 and r03, unlike a
+  position-index scheme). Offset far negative so every backfilled row
+  sorts BEFORE any real wall-clock record; exact integer arithmetic
+  end to end (floats would eat the low-order name bytes)."""
+  prefix = name.encode("utf-8", "replace")[:16].ljust(16, b"\0")
+  return int.from_bytes(prefix, "big") * 4096 + int(line) - 2 ** 141
+
+
+def backfill(repo_dir: str, store_dir: Optional[str] = None,
+             pattern: str = r"BENCH_.*\.json$",
+             log: Callable[[str], None] = print) -> Tuple[int, int]:
+  """Ingest the committed ``BENCH_*.json`` history into the run store
+  so the sentinel has history on day one. ``_CPU_FALLBACK`` rows are
+  tagged ``fallback`` (never baseline-eligible). Idempotent: rows
+  already in the store (by backfill run id + metric) are skipped.
+  Returns (ingested, skipped)."""
+  store = RunStore(store_dir or repo_dir)
+  rx = re.compile(pattern)
+  ingested = skipped = 0
+  names = sorted(n for n in os.listdir(repo_dir) if rx.match(n))
+  for name in names:
+    path = os.path.join(repo_dir, name)
+    rows = bench_rows(path)
+    if not rows:
+      log(f"backfill: no bench record in {name}; skipped")
+      continue
+    stem = os.path.splitext(name)[0]
+    for i, row in enumerate(rows):
+      if row.get("value") is None:
+        skipped += 1
+        continue
+      metric = str(row["metric"])
+      fallback = "_CPU_FALLBACK" in metric
+      run_id = f"backfill-{stem}" + (f"-{i + 1}" if len(rows) > 1
+                                     else "")
+      if store.has_run(run_id, metric):
+        skipped += 1
+        continue
+      rec = run_record(
+          metric=metric, value=float(row["value"]),
+          unit=str(row.get("unit") or "1"),
+          fingerprint=bench_fingerprint(on_tpu=not fallback),
+          run_id=run_id,
+          platform="cpu" if fallback else "tpu",
+          fallback=fallback,
+          git_rev=row.get("git_rev"),
+          jax_version=row.get("jax_version"),
+          snapshot=flatten_stats(row))
+      # Past run_record's float rounding: the ordinal needs exact
+      # integer ordering (see _backfill_ordinal).
+      rec["t_wall"] = _backfill_ordinal(name, i)
+      store.append(rec)
+      ingested += 1
+      log(f"backfill: {name} -> {metric} = {row['value']}"
+          + (" [fallback]" if fallback else ""))
+  log(f"backfill: {ingested} record(s) ingested, {skipped} skipped "
+      f"-> {store.path}")
+  return ingested, skipped
+
+
+# -- schema audit (the run_tests.py --audit leg) ------------------------------
+
+def _ast_emitted_keys(path: str) -> List[Tuple[str, int]]:
+  """Literal keys of the metric-emitting dicts in a source file: any
+  dict literal that carries an ``images_per_sec`` key (the benchmark
+  stats dicts) or both ``metric`` and ``value`` (the bench JSON
+  record), plus ``record["..."]``-style subscript assignments onto
+  such a dict's name."""
+  import ast
+  try:
+    tree = ast.parse(open(path, encoding="utf-8").read())
+  except (OSError, SyntaxError):
+    return []
+  out = []
+  for node in ast.walk(tree):
+    if not isinstance(node, ast.Dict):
+      continue
+    keys = [k.value for k in node.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, str)]
+    if "images_per_sec" in keys or {"metric", "value"} <= set(keys):
+      out.extend((k, node.lineno) for k in keys)
+  for node in ast.walk(tree):
+    if (isinstance(node, ast.Assign) and len(node.targets) == 1
+        and isinstance(node.targets[0], ast.Subscript)
+        and isinstance(node.targets[0].value, ast.Name)
+        and node.targets[0].value.id == "record"
+        and isinstance(node.targets[0].slice, ast.Constant)
+        and isinstance(node.targets[0].slice.value, str)):
+      out.append((node.targets[0].slice.value, node.lineno))
+  return out
+
+
+def schema_audit(repo_dir: str) -> List[str]:
+  """The metrics-schema audit: registry keys vs what the emitters
+  actually produce, plus store-record validity. Pure host-side, no
+  device work (the ``run_tests.py --audit`` budget). Returns problem
+  strings (empty = clean)."""
+  problems: List[str] = []
+  # 1. Schema self-consistency: prometheus names must stay distinct
+  # after sanitization (two keys mapping to one exposition name would
+  # silently merge on the endpoint).
+  prom_names: Dict[str, str] = {}
+  for name in SCHEMA:
+    prom = prometheus_name(name)
+    if prom in prom_names:
+      problems.append(f"schema: {name!r} and {prom_names[prom]!r} both "
+                      f"render as {prom}")
+    prom_names[prom] = name
+  # 2. Health namespace coverage: every key telemetry can emit is
+  # registered.
+  for k in HEALTH_KEYS + HEALTH_SUMMARY_KEYS:
+    if health_key(k) not in SCHEMA:
+      problems.append(f"schema: telemetry key {health_key(k)!r} is not "
+                      "registered")
+  # 3. Tracing coverage: every SAMPLE_KEYS x QUANTILES percentile field
+  # and the ledger aggregates are registered (the registration block is
+  # literal for the lint; this is its staleness check).
+  for key in _tracing.SAMPLE_KEYS:
+    for q in _tracing.QUANTILES:
+      name = f"{key}_p{q}"
+      if name not in SCHEMA:
+        problems.append(f"schema: tracing percentile field {name!r} is "
+                        "not registered")
+  # 4. Emitters: every literal key of the benchmark stats dicts and the
+  # bench JSON record is registered or explicitly non-metric.
+  for rel in ("kf_benchmarks_tpu/benchmark.py", "bench.py"):
+    for key, lineno in _ast_emitted_keys(os.path.join(repo_dir, rel)):
+      if key not in SCHEMA and key not in NON_METRIC_KEYS:
+        problems.append(
+            f"{rel}:{lineno}: emitted metric key {key!r} is neither "
+            "registered in metrics.SCHEMA nor in NON_METRIC_KEYS")
+  # 5. Committed bench history: every BENCH_*.json record field
+  # flattens onto registered keys (the backfill contract).
+  for name in sorted(os.listdir(repo_dir)):
+    if not re.match(r"BENCH_.*\.json$", name):
+      continue
+    rows = bench_rows(os.path.join(repo_dir, name))
+    if not rows:
+      problems.append(f"{name}: no bench record found")
+      continue
+    for row in rows:
+      for key, value in row.items():
+        if key in NON_METRIC_KEYS or value is None:
+          continue
+        if key in ("health",):
+          continue
+        if key == "latency_percentiles" and isinstance(value, dict):
+          for lk in value:
+            if lk not in SCHEMA:
+              problems.append(f"{name}: latency key {lk!r} unregistered")
+          continue
+        if key not in SCHEMA:
+          problems.append(f"{name}: bench JSON key {key!r} is not in "
+                          "the metric schema")
+  # 6. Run store (when present): every record validates against the
+  # current schema version.
+  store = RunStore(repo_dir)
+  for i, rec in enumerate(store.records()):
+    for p in validate_record(rec):
+      problems.append(f"{store.path}: record {i}: {p}")
+  # 7. Exposition self-check: a fully-populated registry renders valid
+  # Prometheus text.
+  reg = MetricRegistry()
+  for name, spec in SCHEMA.items():
+    if spec.kind == "info":
+      reg.set(name, "x")
+    elif spec.kind == "histogram":
+      reg.observe(name, 0.5)
+    elif spec.kind == "counter":
+      reg.inc(name)
+    else:
+      reg.set(name, 1.5)
+  problems.extend("prometheus render: " + p
+                  for p in validate_prometheus_text(reg.render()))
+  return problems
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def main(argv=None) -> int:
+  import argparse
+  repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+  parser = argparse.ArgumentParser(
+      prog="python -m kf_benchmarks_tpu.metrics",
+      description="run-record store tools: backfill BENCH_*.json "
+                  "history, audit the metric schema")
+  sub = parser.add_subparsers(dest="cmd", required=True)
+  p_back = sub.add_parser("backfill",
+                          help="ingest BENCH_*.json into the run store")
+  p_back.add_argument("--repo", default=repo)
+  p_back.add_argument("--run_store_dir", default=None,
+                      help="store directory (default: the repo root, "
+                           "alongside the BENCH_*.json files)")
+  p_audit = sub.add_parser("audit", help="metrics-schema audit")
+  p_audit.add_argument("--repo", default=repo)
+  args = parser.parse_args(argv)
+  if args.cmd == "backfill":
+    backfill(args.repo, args.run_store_dir)
+    return 0
+  problems = schema_audit(args.repo)
+  for p in problems:
+    print(p)
+  print(f"metrics-schema audit: {len(problems)} problem(s)")
+  return 1 if problems else 0
+
+
+if __name__ == "__main__":
+  raise SystemExit(main())

@@ -43,6 +43,8 @@ from typing import Any, Dict, Optional
 import jax
 import numpy as np
 
+from kf_benchmarks_tpu import metrics as metrics_lib
+
 
 # -- one-step trace (ref: benchmark_cnn.py:270-275) -------------------------
 
@@ -702,6 +704,18 @@ class BenchmarkLogger:
     }
     with open(self._metric_path, "a") as f:
       f.write(json.dumps(record) + "\n")
+    # Mirror REGISTERED names into the active metric registry
+    # (metrics.py; no-op sink without a session), so a metric that
+    # reaches the reference-schema benchmark log also reaches the live
+    # /metrics scrape -- one emission, two sinks. Summary names that
+    # live under the health/ namespace map through health_key;
+    # reference-only names (current/average_examples_per_sec) have no
+    # registry analog and stay file-only.
+    if value is not None:
+      if name in metrics_lib.SCHEMA:
+        metrics_lib.active().set(name, value)
+      elif metrics_lib.health_key(name) in metrics_lib.SCHEMA:
+        metrics_lib.active().set(metrics_lib.health_key(name), value)
 
 
 # -- summary writer (ref: benchmark_cnn.py:586-593, 2811-2846) --------------

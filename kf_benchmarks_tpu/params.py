@@ -412,6 +412,32 @@ flags.DEFINE_float("stall_watchdog_factor", 10.0,
                    "documented tunnel-wedge trigger). 0 disables the "
                    "watchdog thread; the first compile is always exempt "
                    "(patient, log-only) (telemetry.py).", lower_bound=0)
+flags.DEFINE_integer("metrics_port", None,
+                     "Serve a live scrape endpoint from the metric "
+                     "registry (metrics.py) on this port: /metrics in "
+                     "Prometheus text format, /healthz from watchdog + "
+                     "flight-recorder state. Under kfrun each rank "
+                     "binds port + rank, so every worker of a "
+                     "single-host job gets its own scrape target. "
+                     "Host-side only: the metrics-on step program is "
+                     "structurally identical to the metrics-off golden "
+                     "(analysis/audit.rule_metrics_twin). Unset = no "
+                     "socket is ever bound. Training runs only "
+                     "(validation.py). No reference analog -- its "
+                     "results ship post-hoc (BenchmarkLogger / BigQuery "
+                     "upload, ref: benchmark_cnn.py:1594-1608).",
+                     lower_bound=1, upper_bound=65535)
+flags.DEFINE_string("run_store_dir", None,
+                    "Append one schema-versioned run record (config "
+                    "fingerprint, git rev, jax version, platform, full "
+                    "metric snapshot) to the append-only JSONL run "
+                    "store in this directory at run end (metrics.py "
+                    "RunStore; rank 0 only) -- the cross-run history "
+                    "the regression sentinel (bench.py "
+                    "--check-regression) compares against. Unset = no "
+                    "record for training runs; bench.py defaults its "
+                    "own store next to the BENCH_*.json trajectory. "
+                    "Training runs only (validation.py).")
 flags.DEFINE_integer("summary_verbosity", 0,
                      "0-3: none / scalars / grad histograms / everything "
                      "(ref :589-593).", lower_bound=0, upper_bound=3)

@@ -59,13 +59,15 @@ import numpy as np
 from jax import lax
 
 from kf_benchmarks_tpu import compat  # noqa: F401 (lax.axis_size shim)
+from kf_benchmarks_tpu import metrics as metrics_lib
 from kf_benchmarks_tpu.utils import log as log_util
 
 
 # Order of the in-step health vector (health_finalize builds it from
-# the pmean'd health_partials inside the step).
-HEALTH_KEYS = ("grad_norm", "update_ratio", "nonfinite_leaves",
-               "loss_scale", "skipped")
+# the pmean'd health_partials inside the step). Single-sourced in the
+# metric registry (metrics.py), where every health/<key> scalar the
+# recorder emits is registered.
+HEALTH_KEYS = metrics_lib.HEALTH_KEYS
 
 
 # -- in-step stats (compiled side) -------------------------------------------
@@ -157,7 +159,11 @@ def health_scalars(metrics) -> Dict[str, float]:
   arr = np.asarray(vec, np.float32).ravel()
   if arr.size != len(HEALTH_KEYS):
     return {}
-  return {f"health/{k}": float(v) for k, v in zip(HEALTH_KEYS, arr)}
+  # Key construction goes through the registry's health_key helper --
+  # the metric-key-literal lint bans assembling the health/ namespace
+  # anywhere outside metrics.py.
+  return {metrics_lib.health_key(k): float(v)
+          for k, v in zip(HEALTH_KEYS, arr)}
 
 
 # variable_update modes whose gradient reduction leaves every replica
@@ -737,6 +743,20 @@ class TelemetrySession:
     s = self.recorder.summary()
     s["watchdog_stalls"] = self.watchdog.stalls
     return s
+
+  def healthz(self) -> Dict[str, Any]:
+    """The /healthz payload half this session owns (metrics.py serves
+    it): liveness read from watchdog + flight-recorder state. "stalled"
+    means the watchdog is currently inside a stall episode -- a scrape
+    can see a live job that stopped dispatching, which is exactly the
+    wedge signature the watchdog exists to diagnose."""
+    stalled = bool(getattr(self.watchdog, "_stalled", False))
+    payload = {"status": "stalled" if stalled else "ok"}
+    payload.update(self.summary())
+    last = self.recorder.tail(1)
+    if last:
+      payload["last_step"] = last[0].get("step")
+    return payload
 
   def close(self, reason: str = "run end") -> None:
     if self._closed:

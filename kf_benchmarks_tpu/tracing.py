@@ -73,6 +73,12 @@ SUBSYSTEMS = ("run", "compile", "dispatch", "device", "feed",
 # Canonical latency-sample keys (the percentile lines / stats fields).
 SAMPLE_KEYS = ("chunk_wall", "feed_wait", "checkpoint_save")
 
+# Reported quantiles. Every ``<key>_p<q>`` stats/bench-JSON field is
+# SAMPLE_KEYS x QUANTILES; the metric registry (metrics.py) registers
+# each rendered key literally and its schema audit cross-checks the
+# registration against these two tuples, so the set cannot drift.
+QUANTILES = (50, 90, 99)
+
 
 def resolve_run_id(wall_fn=time.time) -> str:
   """One run id shared by the trace and the flight recorder.
@@ -310,7 +316,7 @@ class RunTrace:
     line)."""
     out: Dict[str, Optional[float]] = {}
     for key, row in self.percentiles().items():
-      for q in (50, 90, 99):
+      for q in QUANTILES:
         out[f"{key}_p{q}"] = row[f"p{q}"]
     return out
 

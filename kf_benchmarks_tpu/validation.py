@@ -622,6 +622,23 @@ def validate_cross_flags(params) -> None:
         "checkpoint/elastic boundaries, tracing.py); it cannot be "
         "combined with --eval or --forward_only. The jax.profiler "
         "--trace_file capture works in every mode")
+  if getattr(p, "metrics_port", None) and (p.eval or p.forward_only):
+    # The live endpoint serves the TRAIN loop's registry session
+    # (benchmark.py binds it around _train_loop); accepting the flag in
+    # eval/forward-only would bind nothing and log success while
+    # serving nothing (the round-1 ineffective-flag defect class, same
+    # rule as --trace_events_file above).
+    raise ParamError(
+        "--metrics_port serves the training loop's metric registry "
+        "(metrics.py); it cannot be combined with --eval or "
+        "--forward_only")
+  if getattr(p, "run_store_dir", None) and (p.eval or p.forward_only):
+    raise ParamError(
+        "--run_store_dir appends the TRAINING run's record to the "
+        "run store (metrics.py RunStore, written at train-loop end); "
+        "it cannot be combined with --eval or --forward_only. The "
+        "bench/serving records come from bench.py, which owns its own "
+        "store path")
   if p.aot_load_path and not p.forward_only:
     raise ParamError("--aot_load_path requires --forward_only (the "
                      "frozen artifact has no training program; ref: "

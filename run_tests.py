@@ -18,11 +18,13 @@ Usage:
 
 ``--audit`` is the one fast CI lint target (CPU-only, no device work,
 <60 s): the hazard lint (kf_benchmarks_tpu/analysis/lint.py), the
-program-contract audit against tests/golden_contracts/, and the
-tiering audit (the static half always: the SLOW/DISTRIBUTED file lists
-must name real files; the dynamic 60 s rule re-checks the durations
-report saved by the last --check-tiering run, which is the only part
-that needs a real suite run).
+metrics-schema audit (kf_benchmarks_tpu/metrics.py schema vs the
+actual emitters + run-store record validity), the program-contract
+audit against tests/golden_contracts/, and the tiering audit (the
+static half always: the SLOW/DISTRIBUTED file lists must name real
+files; the dynamic 60 s rule re-checks the durations report saved by
+the last --check-tiering run, which is the only part that needs a
+real suite run).
 """
 
 import argparse
@@ -181,6 +183,20 @@ def run_audit_target() -> int:
     print(v.render())
   print(f"hazard lint: {len(violations)} violation(s)")
   failed |= bool(violations)
+  # 1b. Metrics-schema audit: registry keys vs what the emitters (run
+  # stats dicts, bench JSON, BENCH_* history, run-store records)
+  # actually produce. metrics.py is pure stdlib and loaded by PATH for
+  # the same reason as the lint (the package __init__ imports jax).
+  spec = importlib.util.spec_from_file_location(
+      "kf_metrics",
+      os.path.join(REPO, "kf_benchmarks_tpu", "metrics.py"))
+  metrics = importlib.util.module_from_spec(spec)
+  spec.loader.exec_module(metrics)
+  problems = metrics.schema_audit(REPO)
+  for p in problems:
+    print(p)
+  print(f"metrics-schema audit: {len(problems)} problem(s)")
+  failed |= bool(problems)
   # 2. Program contracts vs goldens: needs the 8-device virtual CPU
   # mesh, so it runs in the analysis CLI's own interpreter (which sets
   # XLA_FLAGS before the backend initializes).

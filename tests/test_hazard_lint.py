@@ -317,6 +317,89 @@ def test_trace_emission_allowlist_staleness(tmp_path, monkeypatch):
   assert len(violations) == 1 and "stale" in violations[0].message
 
 
+# -- metric-key-literal -------------------------------------------------------
+
+# A minimal schema home: the rule parses registered keys out of the
+# registration calls' literal first args.
+METRICS_HOME = ("def _gauge(name, unit, help_, source):\n  return name\n"
+                "_gauge('chunk_wall_p50', 's', 'help', 'tracing')\n"
+                "_gauge('health/grad_norm', '1', 'help', 'telemetry')\n")
+
+
+def test_unregistered_metric_key_literal_seeded(tmp_path, monkeypatch):
+  monkeypatch.setattr(lint, "METRIC_KEY_ALLOWLIST", {})
+  _seed(tmp_path, "kf_benchmarks_tpu/metrics.py", METRICS_HOME)
+  _seed(tmp_path, "kf_benchmarks_tpu/rogue_metrics.py",
+        "STATS = {'queue_depth_p50': 1.0}\n")
+  violations = _rules(tmp_path, "metric-key-literal")
+  assert [v.path for v in violations] == \
+      ["kf_benchmarks_tpu/rogue_metrics.py"]
+  assert "queue_depth_p50" in violations[0].message
+  assert lint.main(["--root", str(tmp_path),
+                    "--rules", "metric-key-literal"]) == 1
+
+
+def test_registered_metric_key_literal_clean(tmp_path, monkeypatch):
+  monkeypatch.setattr(lint, "METRIC_KEY_ALLOWLIST", {})
+  # The compliant twin reads REGISTERED keys -- reads are free, only
+  # unregistered lookalikes are violations.
+  _seed(tmp_path, "kf_benchmarks_tpu/metrics.py", METRICS_HOME)
+  _seed(tmp_path, "kf_benchmarks_tpu/reader.py",
+        "def f(lat):\n  return lat.get('chunk_wall_p50')\n")
+  _seed(tmp_path, "kf_benchmarks_tpu/recorder.py",
+        "def g(rec):\n  return rec['health/grad_norm']\n")
+  assert not _rules(tmp_path, "metric-key-literal")
+
+
+def test_fstring_metric_key_construction_seeded(tmp_path, monkeypatch):
+  monkeypatch.setattr(lint, "METRIC_KEY_ALLOWLIST", {})
+  _seed(tmp_path, "kf_benchmarks_tpu/metrics.py", METRICS_HOME)
+  _seed(tmp_path, "kf_benchmarks_tpu/rogue_health.py",
+        "def scalars(keys, vals):\n"
+        "  return {f'health/{k}': v for k, v in zip(keys, vals)}\n")
+  violations = _rules(tmp_path, "metric-key-literal")
+  assert len(violations) == 1 and "f-string" in violations[0].message
+  # ...and the percentile-suffix form is construction too -- with the
+  # quantile formatted OR literal (the `f"{key}_p50"` evasion).
+  _seed(tmp_path, "kf_benchmarks_tpu/rogue_health.py",
+        "def fields(key, q):\n  return f'{key}_p{q}'\n")
+  assert _rules(tmp_path, "metric-key-literal")
+  _seed(tmp_path, "kf_benchmarks_tpu/rogue_health.py",
+        "def fields(key):\n  return f'{key}_p50'\n")
+  assert _rules(tmp_path, "metric-key-literal")
+  # ...and '+'-concatenation is the same construction by other means.
+  _seed(tmp_path, "kf_benchmarks_tpu/rogue_health.py",
+        "def scalars(k):\n  return 'health/' + k\n")
+  violations = _rules(tmp_path, "metric-key-literal")
+  assert len(violations) == 1 and "concatenation" in violations[0].message
+
+
+def test_metric_key_construction_allowed_in_home(tmp_path, monkeypatch):
+  monkeypatch.setattr(lint, "METRIC_KEY_ALLOWLIST", {})
+  _seed(tmp_path, "kf_benchmarks_tpu/metrics.py",
+        METRICS_HOME + "def health_key(k):\n  return 'health/' + k\n"
+        "X = {f'health/{k}': 1 for k in ('a',)}\n")
+  assert not _rules(tmp_path, "metric-key-literal")
+
+
+def test_metric_key_literal_outside_package_not_this_rules_business(
+    tmp_path, monkeypatch):
+  monkeypatch.setattr(lint, "METRIC_KEY_ALLOWLIST", {})
+  _seed(tmp_path, "kf_benchmarks_tpu/metrics.py", METRICS_HOME)
+  _seed(tmp_path, "tests/test_x.py", "K = 'made_up_p99'\n")
+  _seed(tmp_path, "experiments/probe.py", "K = 'made_up_p99'\n")
+  assert not _rules(tmp_path, "metric-key-literal")
+
+
+def test_metric_key_allowlist_staleness(tmp_path, monkeypatch):
+  _seed(tmp_path, "kf_benchmarks_tpu/metrics.py", METRICS_HOME)
+  _seed(tmp_path, "kf_benchmarks_tpu/clean.py", "X = 1\n")
+  monkeypatch.setattr(lint, "METRIC_KEY_ALLOWLIST",
+                      {"kf_benchmarks_tpu/clean.py": "legacy producer"})
+  violations = _rules(tmp_path, "metric-key-literal")
+  assert len(violations) == 1 and "stale" in violations[0].message
+
+
 # -- flag-validation ----------------------------------------------------------
 
 PARAMS = ("from kf_benchmarks_tpu import flags\n\n"
