@@ -33,6 +33,13 @@ def main(argv=None):
            "same-fingerprint history in the run store (noise-aware "
            "MAD bar, metrics.py check_regression); prints a verdict "
            "line to stderr and exits nonzero on a regression")
+  parser.add_argument(
+      "--autotuned_config", default=None,
+      help="tuned-config table to apply at startup "
+           "(analysis/autotune.py; benchmark.setup logs the "
+           "provenance line). The applied knobs are program-shaping "
+           "params, so the run-store fingerprint below keys the tuned "
+           "run apart from default history automatically")
   args = parser.parse_args(argv)
 
   from kf_benchmarks_tpu import metrics as metrics_lib
@@ -102,7 +109,12 @@ def main(argv=None):
   # health_stats explicit opt-in -- the bench has no train_dir, so
   # auto would stay off and the one-line JSON would lose its
   # run-health aggregate; use_fp16 means bfloat16 compute on TPU.)
-  params = params_lib.make_params(**metrics_lib.bench_params_kwargs(on_tpu))
+  bench_kwargs = metrics_lib.bench_params_kwargs(on_tpu)
+  if args.autotuned_config:
+    bench_kwargs["autotuned_config"] = args.autotuned_config
+  params = params_lib.make_params(**bench_kwargs)
+  # setup() applies --autotuned_config (with the provenance line), so
+  # the params this process fingerprints below are the APPLIED ones.
   params = benchmark.setup(params)
   bench = benchmark.BenchmarkCNN(params)
   stats = bench.run()
@@ -172,6 +184,12 @@ def main(argv=None):
       "shapes": ledger.get("shapes", 0),
       "total_compile_s": ledger.get("total_compile_s"),
   }
+  # Tuned-config provenance (--autotuned_config): {path, entry} when a
+  # table was applied (entry None when it held no row for this
+  # config), null otherwise -- so a BENCH_* line always says whether a
+  # tuned table shaped it. _CPU_FALLBACK semantics unchanged: the
+  # field describes whatever run actually executed.
+  record["tuned_config"] = stats.get("tuned_config")
   # Run-health summary (telemetry.py): BENCH_*.json records whether the
   # run was HEALTHY, not just fast -- a throughput number next to
   # nonfinite_steps > 0 or a watchdog stall is a different story than
@@ -196,11 +214,17 @@ def main(argv=None):
   print(json.dumps(record), flush=True)
   return record_and_check(record, on_tpu, args.run_store_dir,
                           args.check_regression,
-                          run_id=stats.get("run_id"))
+                          run_id=stats.get("run_id"),
+                          # Fingerprint of the RESOLVED params: a tuned
+                          # run keys apart from default history (the
+                          # tuned knobs are program-shaping), so
+                          # --check-regression compares like with like.
+                          fingerprint=metrics_lib.bench_fingerprint(
+                              on_tpu, params=params))
 
 
 def record_and_check(record, on_tpu, store_dir, check_regression,
-                     run_id=None) -> int:
+                     run_id=None, fingerprint=None) -> int:
   """Append this run's record to the run store; under
   --check-regression, judge it against the trailing same-fingerprint
   median and return the process exit code (nonzero = regression).
@@ -215,7 +239,7 @@ def record_and_check(record, on_tpu, store_dir, check_regression,
     rec = metrics_lib.run_record(
         metric=record["metric"], value=record["value"],
         unit=record["unit"],
-        fingerprint=metrics_lib.bench_fingerprint(on_tpu),
+        fingerprint=fingerprint or metrics_lib.bench_fingerprint(on_tpu),
         # The RUN'S id (stats carry the trace session's), so the store
         # record joins its trace/flight-recorder artifacts; minted only
         # when the caller has none (synthetic-record tests).

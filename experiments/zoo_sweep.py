@@ -30,8 +30,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from experiments.serving_sweep import run_cli  # noqa: E402
 
 
-def run_point(cli, timeout=3600, mfu=False):
+def run_point(cli, soft_deadline_s=3600, mfu=False):
   """One sweep point -> (img/s, mfu or None).
+
+  TPU-bound subprocesses run under serving_sweep's MONITORED-WAIT
+  (poll + heartbeat + clean-exit UNAVAILABLE retry, never a kill --
+  the timeout kill mid-claim/mid-compile is the documented
+  tunnel-wedge trigger, CLAUDE.md round-4 incident);
+  ``soft_deadline_s`` only changes when the parent starts logging
+  that the point is slow.
 
   ``mfu=True`` adds the MFU column: measured FLOP/s / 197 TFLOP/s
   (VERDICT stretch #9) -- the train program's static flop count from
@@ -39,18 +46,17 @@ def run_point(cli, timeout=3600, mfu=False):
   times the measured steps/s. OPT-IN because --tfprof_file compiles
   the step a second time ahead of the jit cache's own compile
   (benchmark.py logs this), and on the chip a first compile of a
-  novel program can exceed 30 min: doubling compile work inside
-  run_cli's kill-based subprocess timeout is the documented
-  tunnel-wedge trigger (CLAUDE.md). Callers passing mfu=True should
-  size ``timeout`` for two compiles."""
+  novel program can exceed 30 min; callers passing mfu=True should
+  size ``soft_deadline_s`` for two compiles."""
   if not mfu:
-    return run_cli(cli, timeout=timeout), None
+    return run_cli(cli, soft_deadline_s=soft_deadline_s), None
   # Lazy import so the sweep stays runnable from a bare checkout when
   # the MFU column is off.
   from kf_benchmarks_tpu.observability import TPU_PEAK_FLOPS
   with tempfile.TemporaryDirectory() as td:
     prof = os.path.join(td, "prof.json")
-    ips = run_cli(cli + [f"--tfprof_file={prof}"], timeout=timeout)
+    ips = run_cli(cli + [f"--tfprof_file={prof}"],
+                  soft_deadline_s=soft_deadline_s)
     flops = None
     try:
       with open(prof) as f:
@@ -97,6 +103,80 @@ ZOO = [
 ]
 
 
+def _extra_kwargs(extra):
+  """'--data_name=cifar10'-style extra CLI args as make_params kwargs
+  (the autotune path runs in-process, not through the CLI parser)."""
+  out = {}
+  for arg in extra:
+    k, _, v = arg.lstrip("-").partition("=")
+    for cast in (int, float):
+      try:
+        v = cast(v)
+        break
+      except ValueError:
+        pass
+    out[k] = v
+  return out
+
+
+def autotune_bases(only, device):
+  """The base configs --autotune searches: the ZOO rows' sweep
+  settings, with each row's extra CLI args OVERRIDING the common
+  defaults (deepspeech2/ncf set their own --optimizer). health_stats
+  is pinned True -- the bench.py canonical config -- so the emitted
+  entries serve `bench.py --autotuned_config` / `--check-regression`
+  directly; CLI training runs apply them with `--health_stats=true`
+  (the flag is program-shaping, so it is part of the table identity
+  on purpose)."""
+  bases = []
+  for model, bs, extra in ZOO:
+    if only and model not in only:
+      continue
+    base = dict(model=model, batch_size=bs, device=device,
+                num_devices=1, use_fp16=device == "tpu",
+                optimizer="momentum", health_stats=True)
+    base.update(_extra_kwargs(extra))
+    bases.append(base)
+  return bases
+
+
+def run_autotune(args):
+  """--autotune: the contract-driven knob search (analysis/autotune.py)
+  over the zoo, IN-PROCESS -- one process, strictly sequential probes,
+  which on the chip IS the serialization rule (CLAUDE.md; no
+  subprocess, so no kill-timeout class at all). Emits the tuned-config
+  table --num_batches-independent runs apply via --autotuned_config."""
+  from kf_benchmarks_tpu.analysis import autotune
+
+  if args.device == "cpu":
+    # Flip the platform AFTER import (CLAUDE.md): under the pinned
+    # axon env the process exposes NO cpu devices, and the mesh
+    # builder's device lookup would silently fall back to the TPU --
+    # probes would measure the chip and record it as cpu tuning.
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+  else:
+    # Real backend: go through setup()'s reachability probe so a
+    # wedged tunnel fails loudly up front instead of hanging the
+    # in-process sweep (bench.py's rule).
+    from kf_benchmarks_tpu import benchmark
+    from kf_benchmarks_tpu import params as params_lib
+    benchmark.setup(params_lib.make_params(device=args.device,
+                                           num_devices=1))
+  table = autotune.autotune_configs(
+      autotune_bases(args.only, args.device), out=args.out,
+      seed=args.seed, dry_run=args.dry_run)
+  print("\n| model | tuned knobs | default img/s | tuned img/s |")
+  print("|---|---|---|---|")
+  for key in sorted(table["entries"]):
+    e = table["entries"][key]
+    knobs = ", ".join(f"{k}={v}" for k, v in sorted(e["tuned"].items())
+                      if v is not None) or "(defaults)"
+    print(f"| {e['model']} | {knobs} | "
+          f"{e['default_images_per_sec'] or '-'} | "
+          f"{e['tuned_images_per_sec'] or '-'} |")
+
+
 def main():
   ap = argparse.ArgumentParser(description=__doc__)
   ap.add_argument("--batches", type=int, default=40)
@@ -105,8 +185,19 @@ def main():
   ap.add_argument("--device", default="tpu")
   ap.add_argument("--mfu", action="store_true",
                   help="add the measured-MFU column (costs a second "
-                       "compile per point via --tfprof_file; the "
-                       "timeout doubles to cover it)")
+                       "compile per point via --tfprof_file; the soft "
+                       "deadline doubles to cover it)")
+  ap.add_argument("--autotune", action="store_true",
+                  help="run the contract-driven knob search per model "
+                       "(analysis/autotune.py) instead of the fixed-"
+                       "config sweep, and write the tuned-config table")
+  ap.add_argument("--out", default="tuned_configs.json",
+                  help="--autotune: tuned-table output path")
+  ap.add_argument("--seed", type=int, default=0,
+                  help="--autotune: candidate-subsample seed")
+  ap.add_argument("--dry-run", action="store_true", dest="dry_run",
+                  help="--autotune: static stages only (nothing "
+                       "executes)")
   args = ap.parse_args()
 
   if args.only:
@@ -115,6 +206,9 @@ def main():
     if bad:
       raise SystemExit(f"unknown --only models {sorted(bad)}; "
                        f"choose from {sorted(known)}")
+
+  if args.autotune:
+    return run_autotune(args)
 
   rows = []
   for model, bs, extra in ZOO:
@@ -127,9 +221,10 @@ def main():
            "--use_fp16=true", "--optimizer=momentum",
            "--display_every=10"] + extra
     try:
-      ips, mfu = run_point(cli, timeout=7200 if args.mfu else 3600,
-                           mfu=args.mfu)
-    except (RuntimeError, subprocess.TimeoutExpired) as e:
+      ips, mfu = run_point(
+          cli, soft_deadline_s=7200 if args.mfu else 3600,
+          mfu=args.mfu)
+    except (RuntimeError, subprocess.SubprocessError) as e:
       # A single slow/failed point must not discard the completed
       # serialized TPU runs -- record it and keep sweeping.
       print(f"{model}: FAILED -- {e}", flush=True)

@@ -25,10 +25,20 @@ Two layers:
   CLAUDE.md's hard-won environment rules (``jax.block_until_ready``
   banned outside ``utils/sync.py``, version gates need a comment
   naming the missing API, kill-based timeouts around TPU subprocesses
-  banned in tests, step-line format literals single-sourced, flags
-  must be cross-validated or carry an explicit no-validation marker,
-  reference citations per module). Pure stdlib: importing ``lint``
-  never imports jax.
+  banned in tests and experiments, step-line format literals
+  single-sourced, flags must be cross-validated or carry an explicit
+  no-validation marker, reference citations per module). Pure stdlib:
+  importing ``lint`` never imports jax.
+
+* ``autotune`` -- the **contract-driven autotuner**: the auditor's
+  tracing machinery turned search oracle. Candidates over the tuned
+  program-shaping knobs are pruned statically against memory/
+  collective bounds (never executed), cost-ranked from the contract
+  inventory, confirmed with differential measured probes, and emitted
+  as a versioned tuned-config table ``--autotuned_config`` applies at
+  startup; the same module's ``warm`` precompiles every
+  (table x compile-ledger) program shape into the persistent XLA
+  cache ahead of a hardware window.
 
 CLI: ``python -m kf_benchmarks_tpu.analysis`` (see ``__main__``);
 CI entry: ``python run_tests.py --audit``.

@@ -79,7 +79,36 @@ PROGRAM_SHAPE_EXCLUDE = frozenset({
     "stall_watchdog_factor", "fault_schedule",
     "elastic_check_every_n_steps", "sync_on_finish",
     "metrics_port", "run_store_dir",
+    # The tuned-table PATH (--autotuned_config) is plumbing, not a
+    # program shape: the knobs a table APPLIES are ordinary
+    # program-shaping params (TUNED_KNOBS below) and land in the
+    # fingerprint through their own fields, so a tuned run and a
+    # default run can never share a fingerprint -- but WHICH file the
+    # values came from must not fragment the key corpus.
+    "autotuned_config",
 })
+
+# The program-shaping knobs the autotuner (analysis/autotune.py)
+# searches. Deliberately NOT in PROGRAM_SHAPE_EXCLUDE: each one changes
+# the compiled program or its dispatch schedule, so two runs that
+# differ in a tuned knob must key differently in the run store /
+# compile ledger (tests/test_autotune.py pins each knob's effect on
+# config_fingerprint_key). The autotuner strips exactly this set (plus
+# the run-length counters below) to derive the table key a tuned and a
+# default run of the same base config share.
+TUNED_KNOBS = (
+    "steps_per_dispatch",
+    "num_grad_accum",
+    "reduce_bucket_mb",
+    "input_prefetch_depth",
+    "attn_block",
+)
+
+# Run-length counters: in the full fingerprint (the LR schedule can
+# embed the total step count as a program constant), but OUT of the
+# tuned-table base key -- a table tuned at one sweep length must apply
+# to production runs of any length.
+_RUN_LENGTH_FIELDS = ("num_batches", "num_warmup_batches", "num_epochs")
 
 
 def fingerprint_key(payload: Dict[str, Any]) -> str:
@@ -88,6 +117,21 @@ def fingerprint_key(payload: Dict[str, Any]) -> str:
   golden fingerprints."""
   canon = json.dumps(payload, sort_keys=True, default=str)
   return hashlib.sha256(canon.encode("utf-8")).hexdigest()[:16]
+
+
+def _canonical_value(v):
+  """Numeric canonicalization for fingerprinting: an integral float
+  keys as its int. The CLI parser materializes float flags as 0.0
+  where ``make_params`` keeps a registry-literal 0 -- Python-equal,
+  canonical-JSON-different -- and both shape the SAME program, so a
+  CLI run and a library run of one config must share a fingerprint
+  (found when the tuned-table lookup missed from the CLI; the same
+  split silently fragmented the compile ledger). Bools pass through
+  (they are typed consistently on both paths)."""
+  if isinstance(v, float) and not isinstance(v, bool) and \
+      v.is_integer():
+    return int(v)
+  return v
 
 
 def config_fingerprint_key(config: Dict[str, Any],
@@ -99,8 +143,9 @@ def config_fingerprint_key(config: Dict[str, Any],
   it). Call it with the full ``params._asdict()`` (the ledger
   convention: two runs key equal iff every program-shaping field --
   explicit or default -- agrees); None values and the excluded
-  host-side fields drop out first."""
-  shape = {k: v for k, v in config.items()
+  host-side fields drop out first, and integral floats key as ints
+  (see :func:`_canonical_value`)."""
+  shape = {k: _canonical_value(v) for k, v in config.items()
            if v is not None and k not in PROGRAM_SHAPE_EXCLUDE}
   try:
     import jax
@@ -109,6 +154,21 @@ def config_fingerprint_key(config: Dict[str, Any],
     jax_version = ""
   return fingerprint_key({"config": shape, "program": program,
                           "jax": jax_version})
+
+
+def base_fingerprint_key(config: Dict[str, Any],
+                         program: str = "train_step") -> str:
+  """The tuned-table key: :func:`config_fingerprint_key` of ``config``
+  with the tuned knobs (TUNED_KNOBS) and the run-length counters
+  stripped first -- the identity a default run, a tuned run, and the
+  table entry that tuned it all share. Call it with the full
+  ``params._asdict()`` at the MAKE_PARAMS level (before BenchmarkCNN's
+  auto-resolutions -- e.g. the --health_stats auto bool -- mutate the
+  dict): the table is consulted at startup, so its keys live on the
+  pre-resolution config, unlike the compile ledger's resolved keys."""
+  stripped = {k: v for k, v in config.items()
+              if k not in TUNED_KNOBS and k not in _RUN_LENGTH_FIELDS}
+  return config_fingerprint_key(stripped, program)
 
 
 def diff_fingerprints(golden: Dict[str, Any], current: Dict[str, Any]

@@ -223,25 +223,13 @@ def extract_contract(hlo: str, config: Optional[dict] = None,
 N_REPLICAS = 8  # the abstract mesh every golden traces on (conftest's)
 
 
-def trace_contract(overrides: Dict[str, Any],
-                   program: str = "train_step") -> ProgramContract:
-  """Build + lower + compile the step program for ``overrides``; extract.
-
-  Mirrors the runtime exactly (``BenchmarkCNN._build``), but the state
-  is ``jax.eval_shape``-abstract and inputs are ``ShapeDtypeStruct``s:
-  nothing executes, only XLA compilation runs. Requires the 8-device
-  CPU mesh (tests get it from conftest; the CLI sets XLA_FLAGS).
-  """
+def lower_step_program(bench, program: str = "train_step"):
+  """Lower (never execute) a built runtime's step program over abstract
+  ``ShapeDtypeStruct`` inputs -- the one build+lower recipe shared by
+  :func:`trace_contract` and the autotuner's warm pass (the warm pass
+  compiles the result against the persistent XLA cache). Returns
+  ``(state_sds, lowered)``."""
   import jax
-  import jax.numpy as jnp
-  from kf_benchmarks_tpu import benchmark
-  from kf_benchmarks_tpu import params as params_lib
-  from kf_benchmarks_tpu.ops import overlap as overlap_lib
-
-  kw = dict(device="cpu", num_devices=N_REPLICAS, num_batches=2)
-  kw.update(overrides)
-  p = params_lib.make_params(**kw)
-  bench = benchmark.BenchmarkCNN(p)
   fns = bench._build()
   init_state, train_step, train_chunk = fns[0], fns[1], fns[4]
   in_shapes = bench.model.get_input_shapes("train")
@@ -262,9 +250,34 @@ def trace_contract(overrides: Dict[str, Any],
     # Synthetic resident chunk: leading staged-steps axis of 1.
     gx = jax.ShapeDtypeStruct((1,) + gx.shape, gx.dtype)
     gy = jax.ShapeDtypeStruct((1,) + gy.shape, gy.dtype)
-    lowered = train_chunk.lower(state_sds, gx, gy)
-  else:
-    lowered = train_step.lower(state_sds, gx, gy)
+    return state_sds, train_chunk.lower(state_sds, gx, gy)
+  return state_sds, train_step.lower(state_sds, gx, gy)
+
+
+def trace_contract(overrides: Dict[str, Any],
+                   program: str = "train_step") -> ProgramContract:
+  """Build + lower + compile the step program for ``overrides``; extract.
+
+  Mirrors the runtime exactly (``BenchmarkCNN._build``), but the state
+  is ``jax.eval_shape``-abstract and inputs are ``ShapeDtypeStruct``s:
+  nothing executes, only XLA compilation runs. Requires the 8-device
+  CPU mesh (tests get it from conftest; the CLI sets XLA_FLAGS).
+  """
+  import jax
+  import jax.numpy as jnp
+  from kf_benchmarks_tpu import benchmark
+  from kf_benchmarks_tpu import params as params_lib
+  from kf_benchmarks_tpu.ops import overlap as overlap_lib
+
+  kw = dict(device="cpu", num_devices=N_REPLICAS, num_batches=2)
+  kw.update(overrides)
+  p = params_lib.make_params(**kw)
+  bench = benchmark.BenchmarkCNN(p)
+  state_sds, lowered = lower_step_program(bench, program)
+  in_shapes = bench.model.get_input_shapes("train")
+  in_dtypes = bench.model.get_input_data_types("train")
+  n = bench.num_devices
+  n_data = int(getattr(bench, "num_data_replicas", n))
   compiled = lowered.compile()
 
   aux: Dict[str, Any] = {
@@ -362,6 +375,19 @@ def trace_contract(overrides: Dict[str, Any],
     aux["overlap_step_buckets"] = len(buckets)
     aux["overlap_module_prefixes"] = list(module_prefixes)
 
+  # Static flop count (the cost-analysis surface the --tfprof_file dump
+  # reads): the autotuner's cost model consumes it from the aux; absent
+  # on backends without cost analysis. Not part of the golden
+  # fingerprint (baseline.contract_fingerprint reads named aux keys).
+  try:
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+      cost = cost[0] if cost else {}
+    flops = dict(cost or {}).get("flops")
+    if flops is not None and math.isfinite(float(flops)):
+      aux["flops"] = float(flops)
+  except Exception:  # backend-dependent surface
+    pass
   temp = None
   try:
     temp = int(compiled.memory_analysis().temp_size_in_bytes)

@@ -52,6 +52,13 @@ def log_fn(msg):
 # stale cache object before the new dir takes effect.
 _active_compile_cache_dir = None
 
+# The provenance of the LAST --autotuned_config application setup()
+# performed ({path, entry} or None): BenchmarkCNN reuses it (matched by
+# table path) instead of re-reading the table from disk, so the
+# recorded provenance can never disagree with what was applied (e.g. a
+# concurrent table rewrite between setup and construction).
+_applied_tuned_provenance = None
+
 
 def _configure_compile_cache(cache_dir) -> None:
   """Apply ``cache_dir`` (or None = off) as the process's persistent
@@ -271,6 +278,17 @@ def setup(params):
   analogs are XLA flag plumbing and an eager device touch to trigger
   runtime init ahead of the timed region.
   """
+  if getattr(params, "autotuned_config", None):
+    # --autotuned_config: apply the tuned-table entry matching this
+    # run's base fingerprint over the flag values, FIRST -- every
+    # caller (cli.py, bench.py, kfrun workers) goes through setup, so
+    # the params the rest of the process sees (and fingerprints) are
+    # the applied ones. One provenance line either way
+    # (analysis/autotune.py apply_tuned_config).
+    from kf_benchmarks_tpu.analysis import autotune as autotune_lib
+    global _applied_tuned_provenance
+    params, _applied_tuned_provenance = autotune_lib.apply_tuned_config(
+        params, log_fn=log_fn)
   if params.device == "cpu":
     # Explicit CPU request. Note: must go through jax.config AFTER import,
     # not the JAX_PLATFORMS env var -- this environment pins the env var
@@ -331,6 +349,21 @@ class BenchmarkCNN:
     from kf_benchmarks_tpu import params as params_lib
     params_lib.validate_params(params)
     validation.validate_cross_flags(params)
+    # Tuned-config provenance for the stats/run record: reuse what
+    # setup() just applied (matched by table path -- no second disk
+    # read, and the record cannot disagree with the application); a
+    # direct construction without setup falls back to the lookup,
+    # done BEFORE the auto-resolutions below mutate params (the table
+    # keys on the make_params-level config, analysis/autotune.py).
+    # None when --autotuned_config is unset.
+    self._tuned_provenance = None
+    if getattr(params, "autotuned_config", None):
+      prov = _applied_tuned_provenance
+      if prov and prov.get("path") == params.autotuned_config:
+        self._tuned_provenance = dict(prov)
+      else:
+        from kf_benchmarks_tpu.analysis import autotune as autotune_lib
+        self._tuned_provenance = autotune_lib.tuned_provenance(params)
     if params.adaptive_batch_size and not params.track_grad_noise_scale:
       # The adaptive-batch policy keys on the measured noise scale.
       params = params._replace(track_grad_noise_scale=True)
@@ -812,14 +845,12 @@ class BenchmarkCNN:
       except OSError:
         self._compile_cache_warm = False
     if self._compile_cache_warm and p.train_dir:
-      try:
-        with open(os.path.join(p.train_dir, "compile_ledger.json"),
-                  encoding="utf-8") as f:
-          prior = json.load(f)
-        self._prior_ledger_keys = set(
-            (prior.get("entries") or {}).keys())
-      except (OSError, ValueError):
-        self._prior_ledger_keys = set()
+      # The ledger query API (tracing.py read_ledger) -- the same read
+      # the autotuner's warm pass cross-references, so a warmed
+      # train_dir reads as prior history here and the warmed shapes
+      # report cache_hit below.
+      self._prior_ledger_keys = tracing_lib.ledger_keys(
+          tracing_lib.read_ledger(p.train_dir))
     # Everything from the build on runs under the try: a raise anywhere
     # (compile error, bad data_dir, sink failure) must still deactivate
     # the module-global trace session (a leaked active session would
@@ -1959,6 +1990,13 @@ class BenchmarkCNN:
         # bench.py forwards both into its one-line JSON.
         "latency_percentiles": self._trace.percentile_fields() or None,
         "compile_ledger": self._trace.compile_ledger(),
+        # Tuned-config provenance (--autotuned_config,
+        # analysis/autotune.py): table path + the matched entry's base
+        # fingerprint (entry None when the table had no row for this
+        # config); None when the flag is unset. bench.py forwards it
+        # into its one-line JSON and the run-store snapshot, so
+        # --check-regression histories stay attributable.
+        "tuned_config": self._tuned_provenance,
         "run_id": self._trace.run_id or None,
         "state": state,
     }

@@ -241,13 +241,22 @@ _info("git_rev", "Git revision the run was built from", "bench")
 _info("platform", "Execution platform (tpu | cpu)", "bench")
 _info("metric", "Headline metric name", "bench")
 _info("unit", "Headline metric unit", "bench")
+# Tuned-config provenance (--autotuned_config, analysis/autotune.py):
+# flatten_stats expands the nested stats/bench-JSON payload onto these,
+# so the run-store snapshot records WHICH table row shaped a run (the
+# tuned knobs themselves are program-shaping params and already key
+# the record's config fingerprint).
+_info("tuned_config_path", "Tuned-config table the run applied",
+      "autotune")
+_info("tuned_config_entry", "Matched tuned-table entry fingerprint",
+      "autotune")
 
 # Run-stats / bench-JSON keys that are bookkeeping, not metrics: the
 # schema audit accepts them from the emitters without registration.
 NON_METRIC_KEYS = frozenset({
     "state", "stopped_early", "restart_for_resize", "reshape_events",
     "aot_load_path", "value", "entries", "health",
-    "latency_percentiles", "compile_ledger",
+    "latency_percentiles", "compile_ledger", "tuned_config",
 })
 
 _PROM_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
@@ -438,6 +447,12 @@ def flatten_stats(stats: Dict[str, Any]) -> Dict[str, Any]:
       for ck in ("shapes", "total_compile_s"):
         if value.get(ck) is not None:
           out["compile_ledger/" + ck] = float(value[ck])
+      continue
+    if key == "tuned_config" and isinstance(value, dict):
+      if value.get("path"):
+        out["tuned_config_path"] = str(value["path"])
+      if value.get("entry"):
+        out["tuned_config_entry"] = str(value["entry"])
       continue
     spec = SCHEMA.get(key)
     if spec is None:
@@ -824,18 +839,24 @@ def bench_params_kwargs(on_tpu: bool) -> Dict[str, Any]:
   )
 
 
-def bench_fingerprint(on_tpu: bool) -> str:
+def bench_fingerprint(on_tpu: bool, params=None) -> str:
   """Config fingerprint of the headline bench (program name "bench").
 
-  Imports the params registry lazily (jax-adjacent); when that import
-  is unavailable (path-loaded stdlib context) the key degrades to a
-  stable legacy tag so backfill still produces comparable history."""
+  ``params`` is the RESOLVED Params when the caller has them (bench.py
+  after setup -- so a tuned-table application keys the record under
+  the knobs it actually ran with, never the canonical defaults; the
+  run store must not mix tuned and default runs under one
+  fingerprint). Imports the params registry lazily (jax-adjacent);
+  when that import is unavailable (path-loaded stdlib context) the key
+  degrades to a stable legacy tag so backfill still produces
+  comparable history."""
   try:
     from kf_benchmarks_tpu import params as params_lib
     from kf_benchmarks_tpu.analysis import baseline as baseline_lib
   except ImportError:  # the designed degrade: no package/jax available
     return "bench-legacy-" + ("tpu" if on_tpu else "cpu")
-  params = params_lib.make_params(**bench_params_kwargs(on_tpu))
+  if params is None:
+    params = params_lib.make_params(**bench_params_kwargs(on_tpu))
   return baseline_lib.config_fingerprint_key(params._asdict(), "bench")
 
 

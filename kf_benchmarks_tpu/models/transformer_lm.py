@@ -304,6 +304,13 @@ class TransformerLMModel(model_lib.Model):
       raise ValueError(
           f"KF_TRANSFORMER_LM_LAYERS must be 'scan' or 'loop', got "
           f"{layers!r}")
+    # --attn_block (validated against SEQ_LEN in validation.py): one
+    # value drives BOTH tilings -- the K/V block and the matched
+    # q-block -- so an autotuned size never confounds the two-level
+    # schedule with mismatched tiles (the matched-tilings rule the
+    # flash/tiled A/B already follows). None = the module defaults.
+    attn_block = int(getattr(self.params, "attn_block", None) or 0) \
+        if self.params is not None else 0
     # Scan-over-layers params carry a leading depth axis under 'blocks'
     # (PR 2): observability.SummaryWriter unstacks histogram keys per
     # layer via this attribute (tests/test_observability.py).
@@ -357,13 +364,16 @@ class TransformerLMModel(model_lib.Model):
       fsdp_block_hook = overlap_lib.fsdp_block_gatherer(
           block_template, BATCH_AXIS, MODEL_AXIS)
       self.fsdp_gathered_prefixes = ("blocks",)
+    tiling = (dict(attn_block=attn_block, attn_q_block=attn_block)
+              if attn_block else {})
     return _TransformerLMModule(dtype=dtype, param_dtype=param_dtype,
                                 attn_impl=impl,
                                 fused_head=head == "fused",
                                 scan_layers=layers == "scan",
                                 grad_reduce_axis=grad_reduce_axis,
                                 grad_reduce_compact=grad_reduce_compact,
-                                fsdp_block_hook=fsdp_block_hook)
+                                fsdp_block_hook=fsdp_block_hook,
+                                **tiling)
 
   def get_input_shapes(self, subset):
     n = self.get_batch_size()

@@ -89,6 +89,31 @@ flags.DEFINE_integer("input_prefetch_depth", None,
                      "bench JSON shows whether the depth hides host "
                      "preprocessing behind device compute.",
                      lower_bound=1)
+flags.DEFINE_string("autotuned_config", None,
+                    "Path to a tuned-config table "
+                    "(analysis/autotune.py; train_dir/tuned_configs.json "
+                    "from `python -m kf_benchmarks_tpu.analysis autotune` "
+                    "or `experiments/zoo_sweep.py --autotune`). At "
+                    "startup the entry matching this run's base "
+                    "fingerprint (analysis/baseline.base_fingerprint_key "
+                    "-- the config sans the tuned knobs) is applied over "
+                    "the flag values of --steps_per_dispatch, "
+                    "--num_grad_accum, --reduce_bucket_mb, "
+                    "--input_prefetch_depth and --attn_block, with a "
+                    "logged provenance line; no matching entry logs a "
+                    "note and runs with the flag values. Replaces the "
+                    "reference's per-model hand-tuned flag defaults "
+                    "(SURVEY 2) with a measured, per-host table. "
+                    "Training runs only (validation.py).")
+flags.DEFINE_integer("attn_block", None,
+                     "Attention K/V block size of the transformer_lm "
+                     "family's tiled/flash attention (parallel/"
+                     "sequence.py blockwise_attention; the q-block is "
+                     "matched to it). None = the model default "
+                     "(models/transformer_lm.ATTN_BLOCK). Must divide "
+                     "the model's sequence length (validation.py); a "
+                     "program-shaping knob the autotuner searches "
+                     "(analysis/autotune.py TUNED_KNOBS).", lower_bound=8)
 flags.DEFINE_integer("num_batches", None,
                      "Number of timed batches to run (ref :137-139).")
 flags.DEFINE_float("num_epochs", None,

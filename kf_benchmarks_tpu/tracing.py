@@ -79,6 +79,10 @@ SAMPLE_KEYS = ("chunk_wall", "feed_wait", "checkpoint_save")
 # registration against these two tuples, so the set cannot drift.
 QUANTILES = (50, 90, 99)
 
+# The persisted per-train_dir compile ledger (write_ledger /
+# read_ledger below).
+LEDGER_FILENAME = "compile_ledger.json"
+
 
 def resolve_run_id(wall_fn=time.time) -> str:
   """One run id shared by the trace and the flight recorder.
@@ -385,7 +389,7 @@ class RunTrace:
     ledger = self.compile_ledger()
     if not ledger["entries"]:
       return None
-    path = os.path.join(train_dir, "compile_ledger.json")
+    path = os.path.join(train_dir, LEDGER_FILENAME)
     entries: Dict[str, Any] = {}
     try:
       with open(path, encoding="utf-8") as f:
@@ -577,6 +581,43 @@ class RunTrace:
       self._log(f"trace merge write failed (non-fatal): {e}")
       return None
     return self.path
+
+
+# -- compile-ledger query API -------------------------------------------------
+# Read side of the persisted ledger (write_ledger above): the autotuner's
+# warm pass (analysis/autotune.py) cross-references it to decide which
+# program shapes to precompile, and benchmark.py reads the prior keys
+# for the cache_hit heuristic. Pure stdlib, like everything here.
+
+def read_ledger(train_dir: str) -> Dict[str, Any]:
+  """The persisted compile ledger at ``train_dir/compile_ledger.json``
+  ({"entries": {}} when absent/unreadable/foreign-shaped -- a missing
+  ledger must read as empty history, never raise)."""
+  path = os.path.join(train_dir, LEDGER_FILENAME)
+  try:
+    with open(path, encoding="utf-8") as f:
+      data = json.load(f)
+  except (OSError, ValueError):
+    return {"entries": {}}
+  if not isinstance(data, dict) or not isinstance(data.get("entries"),
+                                                  dict):
+    return {"entries": {}}
+  return data
+
+
+def ledger_keys(ledger: Dict[str, Any]) -> set:
+  """The program-shape fingerprint keys a ledger has seen."""
+  return set((ledger or {}).get("entries") or {})
+
+
+def ledger_programs(ledger: Dict[str, Any]) -> set:
+  """The program labels (train_step / train_chunk / eval_step ...) a
+  ledger predicts a job of this train_dir will compile."""
+  out = set()
+  for row in ((ledger or {}).get("entries") or {}).values():
+    if isinstance(row, dict) and row.get("program"):
+      out.add(str(row["program"]))
+  return out
 
 
 def merge_rank_files(path: str, num_ranks: int,

@@ -274,6 +274,31 @@ def validate_cross_flags(params) -> None:
     # --elastic / --adaptive_batch_size compose: every reshape reopens
     # the input stream (benchmark._open_input), and the packer is
     # re-instantiated at the new row count/incarnation seed.
+  if getattr(p, "autotuned_config", None) and (p.eval or p.forward_only):
+    # The tuned table tunes the TRAINING step's program-shaping knobs
+    # (--steps_per_dispatch and friends, analysis/autotune.py); applying
+    # it to eval/forward-only would silently set training-only flags
+    # (the round-1 ineffective-flag defect class, same rule as
+    # --trace_events_file). benchmark.setup() re-checks before applying
+    # so the failure names this flag, not the knob it would have set.
+    raise ParamError(
+        "--autotuned_config tunes the training step's program-shaping "
+        "knobs (analysis/autotune.py); it cannot be combined with "
+        "--eval or --forward_only")
+  if getattr(p, "attn_block", None):
+    if p.model != "transformer_lm":
+      raise ParamError(
+          "--attn_block sizes the transformer_lm attention tiling "
+          f"(parallel/sequence.py); got --model={p.model}. The CNN/"
+          "speech/recsys families have no attention blocks to tile")
+    # Lazy import (the models package imports jax/flax; every caller of
+    # cross-flag validation has them, but module import must stay light).
+    from kf_benchmarks_tpu.models import transformer_lm as _lm
+    if _lm.SEQ_LEN % p.attn_block:
+      raise ParamError(
+          f"--attn_block={p.attn_block} must divide the transformer_lm "
+          f"sequence length {_lm.SEQ_LEN} (blockwise_attention tiles "
+          "the K/V axis in whole blocks)")
   mesh_shape = getattr(p, "mesh_shape", None)
   sharded = bool(getattr(p, "shard_optimizer_state", False))
   if mesh_shape:

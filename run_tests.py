@@ -20,11 +20,14 @@ Usage:
 <60 s): the hazard lint (kf_benchmarks_tpu/analysis/lint.py), the
 metrics-schema audit (kf_benchmarks_tpu/metrics.py schema vs the
 actual emitters + run-store record validity), the program-contract
-audit against tests/golden_contracts/, and the tiering audit (the
-static half always: the SLOW/DISTRIBUTED file lists must name real
-files; the dynamic 60 s rule re-checks the durations report saved by
-the last --check-tiering run, which is the only part that needs a
-real suite run).
+audit against tests/golden_contracts/ -- which also carries the
+tuned-table schema leg (kf_benchmarks_tpu/analysis/autotune.py
+validate_table: knob-registry membership, fingerprint re-derivation,
+stale-jax-version warnings, for the committed tuned_configs.json) --
+and the tiering audit (the static half always: the SLOW/DISTRIBUTED
+file lists must name real files; the dynamic 60 s rule re-checks the
+durations report saved by the last --check-tiering run, which is the
+only part that needs a real suite run).
 """
 
 import argparse

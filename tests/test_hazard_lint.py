@@ -169,9 +169,48 @@ def test_kill_timeout_stock_env_recipe_caught(tmp_path, empty_allowlists):
   assert _rules(tmp_path, "kill-timeout")
 
 
-def test_timeout_outside_tests_dir_not_this_rules_business(tmp_path, empty_allowlists):
+def test_kill_timeout_covers_experiments(tmp_path, empty_allowlists):
+  # Round 17: the rule covers experiments/ too (the zoo_sweep
+  # kill-based run_point was exactly the documented wedge-trigger
+  # class; the monitored-wait pattern replaced it).
   _seed(tmp_path, "experiments/probe.py", TPU_TIMEOUT)
+  assert _rules(tmp_path, "kill-timeout")
+
+
+def test_kill_timeout_experiments_module_level_markers(tmp_path,
+                                                      empty_allowlists):
+  # Experiments assemble TPU arg lists far from the call: the argparse
+  # default-device idiom anywhere in the MODULE marks it TPU-bound,
+  # even when the enclosing function never names the device.
+  _seed(tmp_path, "experiments/sweep.py",
+        "import argparse, subprocess\n\n"
+        "def run(cmd):\n"
+        "  return subprocess.run(cmd, timeout=600)\n\n"
+        "def main():\n"
+        "  ap = argparse.ArgumentParser()\n"
+        '  ap.add_argument("--device", default="tpu")\n')
+  assert _rules(tmp_path, "kill-timeout")
+
+
+def test_kill_timeout_cpu_only_experiment_clean(tmp_path,
+                                                empty_allowlists):
+  # A CPU-only probe (no TPU marker anywhere in the module) keeps its
+  # subprocess timeout: a kill cannot wedge what never touches the
+  # tunnel.
+  _seed(tmp_path, "experiments/cpu_probe.py",
+        "import subprocess\n\n"
+        "def run(cmd):\n"
+        "  return subprocess.run(cmd + ['--device=cpu'], timeout=60)\n")
   assert not _rules(tmp_path, "kill-timeout")
+
+
+def test_kill_timeout_monitored_wait_allowlisted_at_head():
+  # The real tree's one remaining timeout= around a TPU-bound
+  # subprocess is the monitored-wait poll tick itself
+  # (serving_sweep.monitored_cli), carried by a reasoned allowlist
+  # entry -- and test_lint_clean_at_head above proves the entry is
+  # neither missing nor stale.
+  assert "experiments/serving_sweep.py" in lint.KILL_TIMEOUT_ALLOWLIST
 
 
 # -- signal-chain -------------------------------------------------------------
