@@ -40,6 +40,28 @@ def main(argv=None):
            "provenance line). The applied knobs are program-shaping "
            "params, so the run-store fingerprint below keys the tuned "
            "run apart from default history automatically")
+  parser.add_argument(
+      "--serving", action="store_true",
+      help="run the serving-path bench instead of the training-step "
+           "headline: a seeded request replay through the "
+           "continuous-batching engine (kf_benchmarks_tpu/serving/), "
+           "emitting ONE JSON line (tokens/s, TTFT + per-token "
+           "percentiles, shed fraction; _CPU_FALLBACK semantics "
+           "intact) appended to the same run store")
+  parser.add_argument("--serving_requests", type=int, default=None,
+                      help="serving: replayed request count (default: "
+                           "platform-sized)")
+  parser.add_argument("--serving_rate", type=float, default=None,
+                      help="serving: offered load, requests/s "
+                           "(default: platform-sized)")
+  parser.add_argument("--serving_bucket_ladder", default=None,
+                      help="serving: --serving_bucket_ladder params "
+                           "flag passthrough")
+  parser.add_argument("--serving_batching", default=None,
+                      help="serving: continuous | static")
+  parser.add_argument("--metrics_port", type=int, default=None,
+                      help="serving: bind the live /metrics + /healthz "
+                           "endpoint for the duration of the replay")
   args = parser.parse_args(argv)
 
   from kf_benchmarks_tpu import metrics as metrics_lib
@@ -102,6 +124,8 @@ def main(argv=None):
     print(f"TPU unreachable after {attempts} probe(s); last: {detail}; "
           "falling back to CPU", file=sys.stderr, flush=True)
     jax.config.update("jax_platforms", "cpu")
+  if args.serving:
+    return run_serving_bench(args, on_tpu, attempts)
   # The canonical bench config lives in metrics.bench_params_kwargs --
   # ONE copy, shared with the backfill CLI so ingested history and
   # fresh runs compute the same config fingerprint. (num_batches=None
@@ -221,6 +245,103 @@ def main(argv=None):
                           # --check-regression compares like with like.
                           fingerprint=metrics_lib.bench_fingerprint(
                               on_tpu, params=params))
+
+
+def run_serving_bench(args, on_tpu, attempts) -> int:
+  """The serving-path bench: replay a seeded request trace through the
+  continuous-batching engine and print ONE JSON line.
+
+  Platform sizing: the real zoo transformer_lm on a chip; a scaled-down
+  spec on the CPU fallback so the line stays seconds-cheap (the
+  _CPU_FALLBACK metric tag keeps the two from ever mixing in the run
+  store -- and the spec joins the fingerprint anyway)."""
+  from kf_benchmarks_tpu import metrics as metrics_lib
+  from kf_benchmarks_tpu import params as params_lib
+  from kf_benchmarks_tpu import tracing
+  from kf_benchmarks_tpu import validation
+  from kf_benchmarks_tpu.analysis import baseline as baseline_lib
+  from kf_benchmarks_tpu.serving import (
+      EngineConfig, LMSpec, ServingEngine, poisson_workload)
+
+  params = params_lib.make_params(
+      model="transformer_lm", device="tpu" if on_tpu else "cpu",
+      num_devices=1,
+      serving_bucket_ladder=args.serving_bucket_ladder,
+      serving_batching=args.serving_batching)
+  p = params
+  if on_tpu:
+    spec = LMSpec()
+    n_req, rate, max_new = 128, 16.0, 32
+  else:
+    spec = LMSpec(vocab=256, d_model=64, n_layers=2, n_heads=4,
+                  d_ff=128, max_len=128, attn_block=32)
+    n_req, rate, max_new = 24, 8.0, 8
+  # Flag unset = the engine's own default ladder (the params.py help's
+  # contract), so a default bench run fingerprints identically to any
+  # other default-engine consumer.
+  ladder_kw = ({"bucket_ladder":
+                validation.parse_bucket_ladder(p.serving_bucket_ladder)}
+               if p.serving_bucket_ladder else {})
+  cfg = EngineConfig(
+      spec=spec, **ladder_kw,
+      batching=p.serving_batching or "continuous",
+      max_new_tokens=p.serving_max_new_tokens or max_new,
+      max_queue_depth=p.serving_queue_depth or 64,
+      ttft_slo_s=(p.serving_ttft_slo_ms / 1e3
+                  if p.serving_ttft_slo_ms is not None else None),
+      tenant_tokens_per_s=p.serving_tenant_tokens_per_s)
+  n_req = args.serving_requests or n_req
+  rate = args.serving_rate or rate
+
+  trace = tracing.RunTrace(path=None)
+  tracing.activate(trace)
+  registry = metrics_lib.activate(metrics_lib.MetricRegistry())
+  engine = ServingEngine(cfg, seed=0)
+  server = None
+  if args.metrics_port is not None:
+    server = engine.serve_metrics(args.metrics_port, registry)
+    print(f"serving /metrics + /healthz on 127.0.0.1:{server.port}",
+          file=sys.stderr, flush=True)
+  n_warm = engine.warm()  # TTFT must measure the system, not XLA
+  print(f"serving bench: {n_warm} executable(s) warmed across ladder "
+        f"{cfg.bucket_ladder}", file=sys.stderr, flush=True)
+  workload = poisson_workload(n_req, rate, spec, seed=0,
+                              max_new_tokens=cfg.max_new_tokens)
+  engine.replay(workload)
+  stats = engine.stats()
+  if server is not None:
+    server.close()
+
+  metric = ("serving_tokens_per_sec" if on_tpu
+            else "serving_tokens_per_sec_CPU_FALLBACK_tpu_unreachable")
+  value = stats.get("serving/tokens_per_sec") or 0.0
+  ledger = trace.compile_ledger()
+  record = {
+      "metric": metric,
+      "value": round(value, 2),
+      "unit": "tokens/sec",
+      "retries": attempts - 1,
+      "compile_ledger": {"shapes": ledger.get("shapes", 0),
+                         "total_compile_s": ledger.get("total_compile_s")},
+  }
+  # Every serving/* stat is a registered schema key; Nones (an empty
+  # replay) drop so the JSON line stays dense.
+  record.update({k: (round(v, 6) if isinstance(v, float) else v)
+                 for k, v in stats.items() if v is not None})
+  record["git_rev"] = metrics_lib.git_revision()
+  record["platform"] = "tpu" if on_tpu else "cpu"
+  print(json.dumps(record), flush=True)
+  fingerprint = baseline_lib.config_fingerprint_key(
+      {**params._asdict(),
+       "serving_spec": spec.config(),
+       "serving_workload": {"requests": n_req, "rate": rate}},
+      "serving_bench")
+  rc = record_and_check(record, on_tpu, args.run_store_dir,
+                        args.check_regression, run_id=trace.run_id,
+                        fingerprint=fingerprint)
+  tracing.deactivate()
+  metrics_lib.deactivate()
+  return rc
 
 
 def record_and_check(record, on_tpu, store_dir, check_regression,

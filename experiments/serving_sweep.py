@@ -1,17 +1,29 @@
-"""Serving-path measurement: forward-only and AOT throughput/latency
-in NHWC on the real chip (VERDICT r3 item #3).
+"""Serving-path measurement: forward/AOT batch sweep on the chip, and
+the request-engine continuous-vs-static A/B.
 
-Runs the CLI in subprocesses (stock axon environment; SERIALIZED -- one
-TPU client at a time) across a batch-size sweep, in two modes:
+Mode 1 (default; real chip, VERDICT r3 item #3) runs the CLI in
+subprocesses (stock axon environment; SERIALIZED -- one TPU client at
+a time) across a batch-size sweep:
 
   forward  -- the jitted eval program (--forward_only)
   aot      -- export once with --aot_save_path, then benchmark the
               frozen program in a FRESH process via --aot_load_path
               (the TRT-analog serving benchmark)
 
-Prints a markdown table (img/s and ms/batch per bs) for PERF.md.
-
     python experiments/serving_sweep.py [--batches 50] [--bs 32 64 128 256]
+
+Mode 2 (``--engine``; round 18) drives the REAL serving engine
+(kf_benchmarks_tpu/serving/) in-process over a seeded Poisson request
+replay, across offered arrival rates, with TWO arms per rate on the
+SAME workload: continuous in-flight batching vs static batch-and-drain.
+Executables are warmed across the whole bucket ladder first, so TTFT
+measures the system, not XLA. Prints a markdown table + ONE JSON line;
+the verdict bar is the run's OWN static-arm p99 TTFT (never a
+constant). CPU-mesh by default (the chip rows ride the standing tunnel
+campaign); results land in PERF.md round 18.
+
+    python experiments/serving_sweep.py --engine [--rates 40 80 160]
+        [--requests 64] [--ladder 1,4,16] [--seed 0]
 """
 
 from __future__ import annotations
@@ -112,6 +124,110 @@ def run_cli(args, soft_deadline_s=2400):
   return float(m.group(1))
 
 
+def engine_ab(args):
+  """The continuous-vs-static A/B on the serving engine (in-process)."""
+  if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+  if args.engine_device == "cpu":
+    # CLAUDE.md recipe: flip the platform through jax.config AFTER
+    # import (overriding the pinned JAX_PLATFORMS env breaks the relay).
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+  import json
+
+  from kf_benchmarks_tpu import tracing
+  from kf_benchmarks_tpu.serving import (EngineConfig, LMSpec,
+                                         ServingEngine, poisson_workload)
+  from kf_benchmarks_tpu.validation import parse_bucket_ladder
+
+  spec = LMSpec(vocab=512, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+                max_len=128, attn_block=32)
+  ladder = parse_bucket_ladder(args.ladder)
+
+  rows = []
+  for rate in args.rates:
+    arms = {}
+    for batching in ("continuous", "static"):
+      cfg = EngineConfig(spec=spec, bucket_ladder=ladder,
+                         batching=batching,
+                         max_new_tokens=args.max_new,
+                         max_queue_depth=args.requests + 1)
+      # Throwaway warm replay, same arm, same RATE, different seed:
+      # engine.warm() covers the AOT decode/prefill executables, but
+      # the install/grow/compact scatter ops compile lazily per (pack
+      # bucket, decode bucket) shape combo in XLA's process-global op
+      # cache, and WHICH combos occur depends on the arrival-rate
+      # dynamics (bucket flapping) -- without this, a first-use combo
+      # compile mid-measurement masquerades as a batching-policy p99
+      # (the same measure-the-system hygiene as the warm pass before a
+      # chip window).
+      warm_eng = ServingEngine(cfg, seed=args.seed)
+      warm_eng.warm()
+      warm_eng.replay(poisson_workload(args.requests, rate, spec,
+                                       seed=args.seed + 1,
+                                       max_new_tokens=args.max_new))
+      trace = tracing.RunTrace(path=None)
+      tracing.activate(trace)
+      try:
+        eng = ServingEngine(cfg, seed=args.seed)
+        eng.warm()
+        # The SAME seeded workload for both arms: the A/B isolates the
+        # batching policy, nothing else.
+        workload = poisson_workload(args.requests, rate, spec,
+                                    seed=args.seed,
+                                    max_new_tokens=args.max_new)
+        eng.replay(workload)
+        stats = eng.stats()
+        stats["compiles"] = trace.compile_ledger()["shapes"]
+        arms[batching] = stats
+      finally:
+        tracing.deactivate()
+    cont, stat = arms["continuous"], arms["static"]
+    rows.append({"rate": rate, "continuous": cont, "static": stat})
+    print(f"rate={rate}/s: continuous p99 TTFT "
+          f"{1e3 * cont['serving/ttft_p99']:.1f} ms "
+          f"({cont['serving/tokens_per_sec']:.0f} tok/s), static "
+          f"{1e3 * stat['serving/ttft_p99']:.1f} ms "
+          f"({stat['serving/tokens_per_sec']:.0f} tok/s)", flush=True)
+
+  print("\n| rate req/s | arm | ttft p50 ms | ttft p99 ms | tok/s | "
+        "fill | shed |")
+  print("|---|---|---|---|---|---|---|")
+  for row in rows:
+    for arm in ("continuous", "static"):
+      s = row[arm]
+      print(f"| {row['rate']} | {arm} | "
+            f"{1e3 * s['serving/ttft_p50']:.1f} | "
+            f"{1e3 * s['serving/ttft_p99']:.1f} | "
+            f"{s['serving/tokens_per_sec']:.0f} | "
+            f"{s['serving/batch_fill_fraction']:.2f} | "
+            f"{s['serving/shed_fraction']:.2f} |")
+
+  # Verdict: the bar is the run's OWN static-arm measurement per rate.
+  verdicts = []
+  for row in rows:
+    bar = row["static"]["serving/ttft_p99"]
+    got = row["continuous"]["serving/ttft_p99"]
+    verdicts.append(got < bar)
+    print(f"verdict rate={row['rate']}/s: continuous p99 TTFT "
+          f"{1e3 * got:.1f} ms vs static bar {1e3 * bar:.1f} ms -> "
+          + ("PASS" if got < bar else "FAIL"), flush=True)
+  ratios = [row["continuous"]["serving/ttft_p99"] /
+            row["static"]["serving/ttft_p99"] for row in rows]
+  record = {
+      "metric": "serving_continuous_over_static_p99_ttft",
+      "value": round(min(ratios), 4),
+      "unit": "ratio",
+      "requests": args.requests,
+      "max_new_tokens": args.max_new,
+      "ladder": list(ladder),
+      "seed": args.seed,
+      "rows": rows,
+  }
+  print(json.dumps(record), flush=True)
+  return 0 if all(verdicts) else 1
+
+
 def main():
   ap = argparse.ArgumentParser(description=__doc__)
   ap.add_argument("--model", default="resnet50")
@@ -119,7 +235,24 @@ def main():
   ap.add_argument("--warmup", type=int, default=10)
   ap.add_argument("--bs", type=int, nargs="+", default=[32, 64, 128, 256])
   ap.add_argument("--device", default="tpu")
+  ap.add_argument("--engine", action="store_true",
+                  help="run the request-engine continuous-vs-static "
+                       "A/B instead of the subprocess batch sweep")
+  ap.add_argument("--engine_device", default="cpu",
+                  choices=("cpu", "tpu"),
+                  help="engine A/B backend (cpu = the virtual-mesh "
+                       "A/B; tpu rides the standing chip campaign -- "
+                       "serialize, never under a kill timeout)")
+  ap.add_argument("--rates", type=float, nargs="+",
+                  default=[40, 80, 160],
+                  help="offered arrival rates, requests/s")
+  ap.add_argument("--requests", type=int, default=64)
+  ap.add_argument("--max_new", type=int, default=16)
+  ap.add_argument("--ladder", default="1,4,16")
+  ap.add_argument("--seed", type=int, default=0)
   args = ap.parse_args()
+  if args.engine:
+    raise SystemExit(engine_ab(args))
 
   base = [f"--model={args.model}", f"--device={args.device}",
           "--num_devices=1", f"--num_batches={args.batches}",

@@ -92,21 +92,42 @@ def run_audit(args) -> int:
   _force_virtual_cpu_mesh()
   from kf_benchmarks_tpu.analysis import audit, baseline, contracts
 
-  names = (args.configs.split(",") if args.configs
-           else list(contracts.GOLDEN_CONFIGS))
-  unknown = [n for n in names if n not in contracts.GOLDEN_CONFIGS]
+  known = dict(contracts.GOLDEN_CONFIGS)
+  known.update(contracts.SERVING_GOLDEN_CONFIGS)
+  names = (args.configs.split(",") if args.configs else list(known))
+  unknown = [n for n in names if n not in known]
   if unknown:
-    print(f"unknown golden config(s): {unknown}; have "
-          f"{list(contracts.GOLDEN_CONFIGS)}")
+    print(f"unknown golden config(s): {unknown}; have {list(known)}")
     return 2
 
-  configs = {n: contracts.GOLDEN_CONFIGS[n] for n in names}
+  train_names = [n for n in names if n in contracts.GOLDEN_CONFIGS]
+  serving_names = [n for n in names
+                   if n in contracts.SERVING_GOLDEN_CONFIGS]
+  configs = {n: contracts.GOLDEN_CONFIGS[n] for n in train_names}
   tracer = audit.make_memo_tracer()
   report = audit.audit_configs(configs, tracer=tracer)
 
+  # Serving-path contracts: traced through their own lowering recipe
+  # (the engine's AOT decode program), audited by the same rule engine.
+  serving_contracts = {}
+  for name in serving_names:
+    contract = contracts.trace_serving_contract(
+        dict(contracts.SERVING_GOLDEN_CONFIGS[name]))
+    serving_contracts[name] = contract
+    violations = audit.audit_contract(contract, tracer)
+    report["configs"][name] = {
+        "config": dict(contracts.SERVING_GOLDEN_CONFIGS[name]),
+        "violations": [v.as_dict() for v in violations],
+        "collectives": len(contract.collectives),
+        "in_loop_collectives": len(contract.in_loop_collectives()),
+        "gradient_collectives": len(contract.gradient_collectives()),
+    }
+    report["violations"] += len(violations)
+
   diff_total = 0
   for name in names:
-    contract = tracer(configs[name], "train_step")
+    contract = (serving_contracts[name] if name in serving_contracts
+                else tracer(configs[name], "train_step"))
     if args.write_goldens:
       path = baseline.write_golden(name, contract)
       print(f"golden written: {path}")

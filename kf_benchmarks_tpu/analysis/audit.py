@@ -438,6 +438,57 @@ def rule_packed_no_overhead(contract, tracer):
   return out
 
 
+def rule_serving_bounded_decode(contract, tracer):
+  """Round 18: the serving decode step is a bounded-executable, cache-
+  resident program. Binds only on ``serving_decode`` contracts
+  (contracts.trace_serving_contract): (a) the decode batch is a
+  bucket-ladder member -- the engine may only ever compile ladder
+  shapes, which is what bounds the executable set (the e2e half of the
+  same invariant pins ledger compiles <= len(ladder),
+  tests/test_serving.py); (b) the ring-buffer caches are donated
+  (updated in place -- losing the alias doubles serving HBM and breaks
+  the AOT call convention); (c) no program buffer reaches the (B, T,
+  V) logits tensor's size, and nothing exceeds one KV ring buffer (the
+  largest legitimate array) -- a bigger temp is a shape-polymorphic
+  materialization leaking into the per-token step."""
+  if contract.program != "serving_decode":
+    return []
+  out = []
+  ladder = contract.aux.get("bucket_ladder") or []
+  bucket = contract.aux.get("decode_batch")
+  if ladder and bucket not in ladder:
+    out.append(f"decode batch {bucket} is not a bucket-ladder member "
+               f"{ladder} -- an off-ladder shape breaks the bounded "
+               "executable set")
+  if contract.donated_buffers == 0:
+    out.append("KV ring buffers not donated -- the decode step must "
+               "update its cache in place (aliasing lost)")
+  btv = contract.aux.get("vocab_logits_bytes")
+  ring = contract.aux.get("kv_ring_bytes")
+  # The ring is the largest LEGITIMATE array, so only buffers beyond
+  # it are leaks; name the (B, T, V) materialization only when that
+  # ceiling genuinely sits above the ring (a small-vocab spec can put
+  # btv BELOW the ring -- there the ring bound alone binds, and the
+  # ring itself must never fire a false logits violation).
+  if ring and contract.largest_tensor_bytes > ring:
+    if btv and btv > ring and contract.largest_tensor_bytes >= btv:
+      out.append(f"largest decode buffer {contract.largest_tensor_type} "
+                 f"({contract.largest_tensor_bytes} B) reaches the "
+                 f"(B, T, V) logits tensor ({btv} B) -- the per-token "
+                 "step materialized a full-sequence product")
+    else:
+      out.append(f"largest decode buffer {contract.largest_tensor_type} "
+                 f"({contract.largest_tensor_bytes} B) exceeds one KV "
+                 f"ring buffer ({ring} B), the largest legitimate "
+                 "array in the decode step")
+  elif btv and not ring and contract.largest_tensor_bytes >= btv:
+    out.append(f"largest decode buffer {contract.largest_tensor_type} "
+               f"({contract.largest_tensor_bytes} B) reaches the "
+               f"(B, T, V) logits tensor ({btv} B) -- the per-token "
+               "step materialized a full-sequence product")
+  return out
+
+
 # -- program-shape invariants (every config) ----------------------------------
 
 def rule_no_host_transfer(contract, tracer):
@@ -453,6 +504,11 @@ def rule_no_host_transfer(contract, tracer):
 def rule_state_donated(contract, tracer):
   """TrainState is donated (donate_argnums=(0,)): losing the aliasing
   doubles the state's HBM footprint."""
+  if contract.program == "serving_decode":
+    # The serving step donates its KV ring, not a TrainState;
+    # rule_serving_bounded_decode owns that program shape (one owner
+    # per seeded violation).
+    return []
   if contract.donated_buffers == 0:
     return ["no input/output buffer aliasing -- the donated TrainState "
             "stopped aliasing (HBM footprint doubles)"]
@@ -571,6 +627,7 @@ RULES: Dict[str, Callable] = {
     "sharded-opt-bytes": rule_sharded_opt_bytes,
     "fsdp-residency": rule_fsdp_residency,
     "packed-no-overhead": rule_packed_no_overhead,
+    "serving-bounded-decode": rule_serving_bounded_decode,
     "no-host-transfer": rule_no_host_transfer,
     "state-donated": rule_state_donated,
     "single-optimizer-apply": rule_single_optimizer_apply,

@@ -504,3 +504,67 @@ GOLDEN_CONFIGS: "OrderedDict[str, Dict[str, Any]]" = OrderedDict([
                         metrics_port=9309,
                         run_store_dir="run_store")),
 ])
+
+
+# -- serving-path contracts (round 18) ----------------------------------------
+
+# Serving goldens trace the ENGINE's decode-step program (never a train
+# step): overrides are serving/decode.LMSpec fields plus the decode
+# bucket. The production (fast 1-row attention) program at the zoo
+# transformer_lm's real dims -- the shape the engine AOT-compiles per
+# ladder bucket and the bounded-executable rule binds against
+# (audit.rule_serving_bounded_decode).
+SERVING_GOLDEN_CONFIGS: "OrderedDict[str, Dict[str, Any]]" = OrderedDict([
+    ("serving_decode", dict(bucket=4)),
+])
+
+
+def trace_serving_contract(overrides: Dict[str, Any],
+                           program: str = "serving_decode"
+                           ) -> ProgramContract:
+  """Lower + compile (never execute) the serving decode step for an
+  LMSpec override dict; extract its contract.
+
+  Mirrors the engine's AOT path exactly (serving/engine._decode_exe:
+  jit + donated ring buffers + lower + compile over abstract
+  ShapeDtypeStructs), so the golden pins the program the engine will
+  actually cache per bucket."""
+  import dataclasses as _dc
+
+  import jax
+  import jax.numpy as jnp
+  from kf_benchmarks_tpu.serving import decode as decode_lib
+  from kf_benchmarks_tpu.serving import engine as engine_lib
+
+  kw = dict(overrides)
+  bucket = int(kw.pop("bucket", 4))
+  field_names = {f.name for f in _dc.fields(decode_lib.LMSpec)}
+  unknown = sorted(set(kw) - field_names)
+  if unknown:
+    raise ValueError(f"unknown LMSpec override(s) {unknown}; have "
+                     f"{sorted(field_names)}")
+  spec = decode_lib.LMSpec(**kw)
+  # The engine's OWN lowering recipe (decode.decode_lowering_args is
+  # the single source), so this golden pins the program the engine
+  # actually caches per bucket.
+  fn, args, donate = decode_lib.decode_lowering_args(spec, bucket)
+  compiled = jax.jit(fn, donate_argnums=donate).lower(*args).compile()
+  itemsize = jnp.dtype(spec.dtype).itemsize
+  aux: Dict[str, Any] = {
+      "bucket_ladder": list(engine_lib.DEFAULT_BUCKET_LADDER),
+      "decode_batch": bucket,
+      # One ring buffer's bytes (k or v; the largest LEGITIMATE array
+      # in the decode program) -- the residency bound the
+      # bounded-executable rule admits. Anything bigger is a leak
+      # (e.g. a (B, T, V) logits buffer: vocab_logits_bytes below).
+      "kv_ring_bytes": (spec.n_layers * bucket * spec.max_len *
+                        spec.n_heads * spec.head_dim * itemsize),
+      "vocab_logits_bytes": bucket * spec.max_len * spec.vocab * itemsize,
+  }
+  temp = None
+  try:
+    temp = int(compiled.memory_analysis().temp_size_in_bytes)
+  except Exception:  # backend without memory analysis
+    temp = None
+  return extract_contract(compiled.as_text(), config=dict(overrides),
+                          program=program, temp_bytes=temp, aux=aux)

@@ -11,21 +11,85 @@ later processes deserialize and call without retracing Python.
 Freezing == closing the exported function over the trained variables
 (they become constants in the serialized module), exactly the
 variables-to-constants step of the reference.
+
+Every export carries a JSON signature sidecar (``<path>.sig.json``):
+input shape/dtype, batch size, and the config fingerprint
+(analysis/baseline.config_fingerprint_key) of the exporting run -- so a
+serving process can validate a requested batch against what was
+actually exported and fail with the AVAILABLE export list (the bucket
+ladder, when a sweep exported several sizes) instead of an opaque XLA
+arity error deep in the call.
 """
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Callable, Tuple
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import export as jax_export
 
+SIGNATURE_SUFFIX = ".sig.json"
+SIGNATURE_VERSION = 1
+
+
+def signature_path(path: str) -> str:
+  return path + SIGNATURE_SUFFIX
+
+
+def _write_signature(path: str, image_shape, dtype, *, quantize: bool,
+                     nclass: int, fingerprint: Optional[str]) -> None:
+  sig = {
+      "version": SIGNATURE_VERSION,
+      "input_shape": [int(d) for d in image_shape],
+      "input_dtype": jnp.dtype(jnp.float32).name,
+      "batch_size": int(image_shape[0]),
+      "nclass": int(nclass),
+      "dtype": jnp.dtype(dtype).name,
+      "quantize": bool(quantize),
+      "fingerprint": fingerprint,
+  }
+  with open(signature_path(path), "w", encoding="utf-8") as f:
+    json.dump(sig, f, indent=2, sort_keys=True)
+    f.write("\n")
+
+
+def read_signature(path: str) -> Optional[Dict[str, Any]]:
+  """The export's signature sidecar, or None when absent/unreadable
+  (pre-sidecar artifacts stay loadable)."""
+  try:
+    with open(signature_path(path), encoding="utf-8") as f:
+      sig = json.load(f)
+  except (OSError, ValueError):
+    return None
+  return sig if isinstance(sig, dict) else None
+
+
+def sibling_batch_sizes(path: str) -> List[int]:
+  """Batch sizes of every export signature in ``path``'s directory --
+  the available bucket list a mis-sized load error reports (a serving
+  sweep exports one artifact per ladder bucket side by side)."""
+  out = []
+  try:
+    names = os.listdir(os.path.dirname(path) or ".")
+  except OSError:
+    return out
+  for name in sorted(names):
+    if not name.endswith(SIGNATURE_SUFFIX):
+      continue
+    sig = read_signature(os.path.join(os.path.dirname(path) or ".",
+                                      name[:-len(SIGNATURE_SUFFIX)]))
+    if sig and isinstance(sig.get("batch_size"), int):
+      out.append(sig["batch_size"])
+  return sorted(set(out))
+
 
 def export_forward(model, variables, batch_size: int, path: str,
                    nclass: int = 1001, dtype=jnp.float32,
-                   quantize: bool = False) -> int:
+                   quantize: bool = False,
+                   fingerprint: Optional[str] = None) -> int:
   """Serialize the frozen forward pass to ``path``; returns byte size.
 
   ``variables`` (trained params + batch stats) are captured as constants
@@ -33,6 +97,10 @@ def export_forward(model, variables, batch_size: int, path: str,
   ``quantize`` stores the large kernels as int8 + per-channel scales
   and dequantizes inside the program -- the TRT INT8 analog
   (quantization.py; ref --trt_mode :615-620, conversion :2466-2486).
+  ``fingerprint`` is the exporting run's config fingerprint
+  (analysis/baseline.config_fingerprint_key), recorded in the signature
+  sidecar so the artifact stays attributable to the program shape that
+  produced it.
   """
   model.set_batch_size(batch_size)
   module = model.make_module(nclass=nclass, phase_train=False,
@@ -59,11 +127,41 @@ def export_forward(model, variables, batch_size: int, path: str,
   os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
   with open(path, "wb") as f:
     f.write(data)
+  _write_signature(path, image_shape, dtype, quantize=quantize,
+                   nclass=nclass, fingerprint=fingerprint)
   return len(data)
 
 
-def load_forward(path: str) -> Callable:
-  """Deserialize an exported forward program into a callable."""
+def load_forward(path: str, expect_batch: Optional[int] = None,
+                 expect_shape: Optional[tuple] = None) -> Callable:
+  """Deserialize an exported forward program into a callable.
+
+  When the caller states what it is about to serve (``expect_batch`` /
+  ``expect_shape``), the loaded executable's input signature is
+  validated HERE, against the deserialized avals -- a mismatch names
+  the exported signature, the request, and every sibling export's
+  batch size (the available bucket list), instead of surfacing later
+  as an opaque XLA arity/shape error inside the call."""
   with open(path, "rb") as f:
     exported = jax_export.deserialize(f.read())
+  avals = list(exported.in_avals)
+  if avals and (expect_batch is not None or expect_shape is not None):
+    got = tuple(int(d) for d in avals[0].shape)
+    want = tuple(int(d) for d in expect_shape) if expect_shape else None
+    batch_ok = expect_batch is None or (got and got[0] == int(expect_batch))
+    shape_ok = want is None or got == want
+    if not (batch_ok and shape_ok):
+      buckets = sibling_batch_sizes(path)
+      sig = read_signature(path) or {}
+      raise ValueError(
+          f"AOT export {path} serves input {got} "
+          f"(batch {got[0] if got else '?'}"
+          + (f", fingerprint {sig.get('fingerprint')}" if
+             sig.get("fingerprint") else "") + ")"
+          + f"; requested batch {expect_batch}"
+          + (f" shape {want}" if want else "")
+          + (f". Available exported batch size(s) here: {buckets}"
+             if buckets else "")
+          + ". Re-export with --aot_save_path at the serving batch "
+          "size (the bucket ladder bounds the executable set).")
   return exported.call

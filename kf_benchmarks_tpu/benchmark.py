@@ -708,9 +708,14 @@ class BenchmarkCNN:
     benchmark_cnn.py:2405-2525, timed by the forward-only loop)."""
     from kf_benchmarks_tpu import aot
     p = self.params
-    serving_fn = aot.load_forward(p.aot_load_path)
-    log_fn(f"Loaded frozen forward program from {p.aot_load_path}")
     shape = (self.batch_size_per_device,) + self._model_image_shape()
+    # Signature-validated load (aot.py): a batch/shape mismatch fails
+    # HERE with the exported signature and the available bucket list,
+    # not as an XLA arity error mid-loop.
+    serving_fn = aot.load_forward(p.aot_load_path,
+                                  expect_batch=self.batch_size_per_device,
+                                  expect_shape=shape)
+    log_fn(f"Loaded frozen forward program from {p.aot_load_path}")
     images = jax.random.uniform(jax.random.PRNGKey(p.tf_random_seed or 0),
                                 shape, jnp.float32)
     sync.drain(images)  # block_until_ready lies on this backend
@@ -1158,10 +1163,16 @@ class BenchmarkCNN:
       export_dtype = {"FP32": jnp.float32, "FP16": jnp.bfloat16,
                       "INT8": jnp.bfloat16}.get(trt_mode,
                                                 self.compute_dtype)
+      from kf_benchmarks_tpu.analysis import baseline as baseline_lib
       nbytes = aot.export_forward(
           self.model, variables, self.batch_size_per_device,
           p.aot_save_path, nclass=self.dataset.num_classes,
-          dtype=export_dtype, quantize=trt_mode == "INT8")
+          dtype=export_dtype, quantize=trt_mode == "INT8",
+          # Exporting run's program identity, recorded in the signature
+          # sidecar (aot.py): a serving process can tie the artifact
+          # back to the config that froze it.
+          fingerprint=baseline_lib.config_fingerprint_key(
+              p._asdict(), "aot_forward"))
       log_fn(f"Exported frozen forward program to {p.aot_save_path} "
              f"({nbytes} bytes"
              + (f", {trt_mode} serving precision" if trt_mode else "")

@@ -194,6 +194,51 @@ def pack_documents(docs: Iterable[np.ndarray], seq_len: int,
     yield _materialize(rows, batch_size, seq_len)
 
 
+def pack_prompts(prompts: Sequence[np.ndarray], seq_len: int,
+                 batch_size: int):
+  """First-fit placement of serving prompts into ONE packed batch,
+  reporting where each prompt landed.
+
+  The serving engine's prefill half (serving/decode.py): mixed-length
+  prompts pack into a single ``(batch_size, 3, seq_len)`` stack --
+  same layout and conventions as :func:`pack_documents` (1-based
+  segment ids in placement order, per-document positions restarting at
+  0, padding at the row tail) -- so they all prefill in ONE dispatch,
+  and the engine can slice each prompt's K/V span back out of the
+  packed forward. Returns ``(images, placements)`` where
+  ``placements[i]`` is ``(row, offset)`` for prompt ``i``, or ``None``
+  when it did not fit this batch (the engine re-queues those). Rows
+  are filled greedily in prompt order; a prompt longer than
+  ``seq_len`` raises (documents are never split).
+  """
+  rows: List[List[int]] = []          # prompt indices per row
+  offsets: List[Optional[tuple]] = [None] * len(prompts)
+  remaining: List[int] = []
+  for i, doc in enumerate(prompts):
+    doc = np.asarray(doc)
+    if doc.ndim != 1 or doc.size < 1:
+      raise ValueError("prompts must be non-empty 1-D token arrays")
+    if doc.size > seq_len:
+      raise ValueError(
+          f"prompt of {doc.size} tokens exceeds the {seq_len}-token "
+          "context; prompts are never split")
+    row = next((r for r in range(len(rows))
+                if remaining[r] >= doc.size), None)
+    if row is None:
+      if len(rows) >= batch_size:
+        continue  # does not fit this batch; placement stays None
+      rows.append([])
+      remaining.append(seq_len)
+      row = len(rows) - 1
+    offsets[i] = (row, seq_len - remaining[row])
+    rows[row].append(i)
+    remaining[row] -= doc.size
+  batch = _materialize(
+      [[np.asarray(prompts[i]) for i in docs] for docs in rows],
+      batch_size, seq_len)
+  return batch.images, offsets
+
+
 class PackedBatchStream:
   """Infinite seeded packed-batch iterator (the host half of
   ``--packed_sequences``): documents of random tokens with lognormal

@@ -212,6 +212,41 @@ _counter("compile_ledger/shapes", "programs",
 _counter("compile_ledger/total_compile_s", "s",
          "Total compile wall seconds", "tracing")
 
+# Serving engine (serving/engine.py): request-path counters/gauges plus
+# the TTFT / per-token latency percentiles (the serving/* sample keys
+# in tracing.SAMPLE_KEYS render onto the _p50/_p90/_p99 keys here, so
+# the cross-check in schema_audit covers them like every other sampled
+# latency).
+_counter("serving/requests", "requests", "Requests submitted", "serving")
+_counter("serving/completed", "requests", "Requests served to completion",
+         "serving")
+_counter("serving/shed", "requests",
+         "Requests shed by admission control (rejected + expired)",
+         "serving")
+_counter("serving/decode_steps", "steps", "Decode steps dispatched",
+         "serving")
+_gauge("serving/shed_fraction", "1", "Shed fraction of all arrivals",
+       "serving")
+_gauge("serving/queue_depth", "requests",
+       "Admission queue depth (mean at tick time)", "serving")
+_gauge("serving/batch_fill_fraction", "1",
+       "Mean active-slot fraction of the decode bucket", "serving")
+_gauge("serving/active", "requests", "In-flight requests decoding",
+       "serving")
+_gauge("serving/decode_bucket", "requests",
+       "Current bucket-ladder decode batch width", "serving")
+_gauge("serving/tokens_per_sec", "tokens/s",
+       "Generated-token throughput over the serve window", "serving")
+_gauge("serving/ttft_p50", "s", "Time-to-first-token p50", "serving")
+_gauge("serving/ttft_p90", "s", "Time-to-first-token p90", "serving")
+_gauge("serving/ttft_p99", "s", "Time-to-first-token p99", "serving")
+_gauge("serving/token_latency_p50", "s", "Per-token decode latency p50",
+       "serving")
+_gauge("serving/token_latency_p90", "s", "Per-token decode latency p90",
+       "serving")
+_gauge("serving/token_latency_p99", "s", "Per-token decode latency p99",
+       "serving")
+
 # DeviceFeeder (data/device_feed.py): run-end stats + live lanes.
 _counter("fetches", "batches", "Batches delivered to the consumer",
          "feeder")

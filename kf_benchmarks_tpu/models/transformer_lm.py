@@ -74,15 +74,21 @@ class _Block(nn.Module):
   attn_impl: str
   dtype: Any
   param_dtype: Any
+  # Serving (kf_benchmarks_tpu/serving/): decode=True switches the
+  # block to the single-token KV-ring path -- carry (x (B,1,D), pos
+  # (B,)), scanned input/output = this layer's (k, v) ring buffers.
+  # return_kv=True makes the TRAINING/forward branch also emit its
+  # per-position K/V projections as scan outputs (the packed-prefill
+  # cache source). Both default off, so the training program -- and
+  # every golden contract -- is untouched. decode_exact routes the
+  # decode attention through the full-sequence op graph (the
+  # bit-identity oracle mode; sequence.decode_attention).
+  decode: bool = False
+  return_kv: bool = False
+  decode_exact: bool = False
 
   @nn.compact
-  def __call__(self, carry, _):
-    # Carry = (hidden states, packed segment ids or None): the segment
-    # ids ride the scan carry unchanged so every block's attention sees
-    # them without a second scan input (--packed_sequences).
-    x, seg = carry
-    b, t, _d = x.shape
-    head_dim = self.d_model // self.n_heads
+  def __call__(self, carry, xs):
     dense = lambda feats, name, bias=True: nn.Dense(
         feats, use_bias=bias, name=name, dtype=self.dtype,
         param_dtype=self.param_dtype)
@@ -90,6 +96,42 @@ class _Block(nn.Module):
     # the surrounding denses cast back down.
     ln = lambda name: nn.LayerNorm(name=name, dtype=jnp.float32,
                                    param_dtype=self.param_dtype)
+    head_dim = self.d_model // self.n_heads
+    if self.decode:
+      # Single-token decode over the KV ring buffer. Same submodule
+      # names as the forward branch, so trained/initialized variables
+      # apply unchanged; op-for-op the forward row's computation, so
+      # per-token logits are bit-identical to the full-sequence
+      # forward at every prefix length (tests/test_serving.py).
+      x, pos = carry
+      ck, cv = xs
+      b = x.shape[0]
+      t_cache = ck.shape[1]
+      h = ln("ln1")(x).astype(self.dtype)
+      qkv = dense(3 * self.d_model, "qkv", bias=False)(h)
+      qkv = qkv.reshape(b, 1, 3, self.n_heads, head_dim)
+      # Ring write at pos % T (pure select, no arithmetic on the kept
+      # entries -- the bit-identity contract again).
+      write = (jnp.arange(t_cache)[None, :] ==
+               (pos % t_cache)[:, None])[..., None, None]
+      ck = jnp.where(write, qkv[:, :, 1], ck)
+      cv = jnp.where(write, qkv[:, :, 2], cv)
+      att = sequence_lib.decode_attention(
+          qkv[:, :, 0], ck, cv, pos,
+          block=min(self.attn_block, t_cache), impl=self.attn_impl,
+          exact=self.decode_exact,
+          q_block=min(self.attn_q_block, t_cache))
+      x = x + dense(self.d_model, "attn_out")(
+          att.reshape(b, 1, self.d_model))
+      h = ln("ln2")(x).astype(self.dtype)
+      h = nn.gelu(dense(self.d_ff, "mlp_up")(h))
+      x = x + dense(self.d_model, "mlp_down")(h)
+      return (x, pos), (ck, cv)
+    # Carry = (hidden states, packed segment ids or None): the segment
+    # ids ride the scan carry unchanged so every block's attention sees
+    # them without a second scan input (--packed_sequences).
+    x, seg = carry
+    b, t, _d = x.shape
     h = ln("ln1")(x).astype(self.dtype)
     qkv = dense(3 * self.d_model, "qkv", bias=False)(h)
     qkv = qkv.reshape(b, t, 3, self.n_heads, head_dim)
@@ -116,6 +158,13 @@ class _Block(nn.Module):
     h = ln("ln2")(x).astype(self.dtype)
     h = nn.gelu(dense(self.d_ff, "mlp_up")(h))
     x = x + dense(self.d_model, "mlp_down")(h)
+    # return_kv: the per-position K/V projections ride the scan outputs
+    # (stacked (L, B, T, H, Dh) by nn.scan) -- exactly the arrays a
+    # decode step would have written at those positions, so a packed
+    # prefill builds the same ring-buffer contents the incremental path
+    # would (serving/decode.py). None keeps the legacy program.
+    if self.return_kv:
+      return (x, seg), (qkv[:, :, 1], qkv[:, :, 2])
     return (x, seg), None
 
 
@@ -166,9 +215,23 @@ class _TransformerLMModule(nn.Module):
   max_len: int = SEQ_LEN
   dtype: Any = jnp.float32
   param_dtype: Any = jnp.float32
+  # Serving (kf_benchmarks_tpu/serving/): decode=True switches
+  # __call__ to the single-token KV-ring path -- (tokens (B,),
+  # cache_k/cache_v (L, B, T, H, Dh), pos (B,)) -> (logits (B, 1, V),
+  # (cache_k', cache_v')); return_kv=True makes the full-sequence
+  # forward additionally return the stacked per-layer K/V projections
+  # (the packed-prefill cache source). Both off = the exact legacy
+  # program (golden contracts unchanged). decode_exact selects the
+  # bit-identity oracle attention schedule over the ~T x cheaper 1-row
+  # production one (sequence.decode_attention).
+  decode: bool = False
+  return_kv: bool = False
+  decode_exact: bool = False
 
   @nn.compact
-  def __call__(self, tokens):
+  def __call__(self, tokens, cache_k=None, cache_v=None, pos=None):
+    if self.decode:
+      return self._decode_call(tokens, cache_k, cache_v, pos)
     tokens = tokens.astype(jnp.int32)
     seg = positions = None
     if tokens.ndim == 3:
@@ -183,7 +246,7 @@ class _TransformerLMModule(nn.Module):
         d_model=self.d_model, n_heads=self.n_heads, d_ff=self.d_ff,
         attn_block=self.attn_block, attn_q_block=self.attn_q_block,
         attn_impl=self.attn_impl, dtype=self.dtype,
-        param_dtype=self.param_dtype)
+        param_dtype=self.param_dtype, return_kv=self.return_kv)
 
     x = nn.Embed(self.vocab, self.d_model, name="embed",
                  dtype=self.dtype, param_dtype=self.param_dtype)(tokens)
@@ -235,11 +298,18 @@ class _TransformerLMModule(nn.Module):
           variable_axes={"params": 0},
           split_rngs={"params": True},
           length=self.n_layers)(name="blocks", **block_kwargs)
-      (x, _), _ = blocks((x, seg), None)
+      (x, _), kv = blocks((x, seg), None)
     else:
+      kv_rows = []
       for i in range(self.n_layers):
-        (x, _), _ = _Block(name=f"block_{i}", **block_kwargs)((x, seg),
-                                                              None)
+        (x, _), kv_i = _Block(name=f"block_{i}", **block_kwargs)(
+            (x, seg), None)
+        kv_rows.append(kv_i)
+      # Stack the per-layer K/V rows like nn.scan would, so the two
+      # layer paths hand serving the same (L, B, T, H, Dh) layout.
+      kv = (jnp.stack([r[0] for r in kv_rows]),
+            jnp.stack([r[1] for r in kv_rows])) if self.return_kv \
+          else None
 
     x = nn.LayerNorm(name="ln_f", dtype=jnp.float32,
                      param_dtype=self.param_dtype)(x)
@@ -258,10 +328,66 @@ class _TransformerLMModule(nn.Module):
     if self.fused_head:
       # No logits here at ALL: the head matmul itself is deferred into
       # the chunked loss/accuracy reductions (ops/fused_loss.py).
-      return fused_loss_lib.FusedLMHead(
-          hidden=x.astype(self.dtype), kernel=w_head), aux
+      out = fused_loss_lib.FusedLMHead(
+          hidden=x.astype(self.dtype), kernel=w_head)
+    else:
+      out = x.astype(self.dtype) @ w_head.astype(self.dtype)
+    if self.return_kv:
+      return out, aux, kv
+    return out, aux
+
+  def _decode_call(self, tokens, cache_k, cache_v, pos):
+    """The single-token KV-ring decode step (serving/decode.py).
+
+    ``tokens`` (B,) int32 is each slot's CURRENT token at absolute
+    position ``pos`` (B,); its K/V are written into the ring at
+    ``pos % T`` and the returned (B, 1, V) logits predict position
+    ``pos + 1``. Ring semantics: within the first ``max_len`` tokens
+    the cache index IS the absolute position (and decode is
+    bit-identical to the full-sequence forward); past it the buffer
+    wraps and attention covers the trailing ``max_len``-token window.
+    Always the dense head -- a (B, 1, V) logits row is microscopic
+    next to the fused head's reason for existing.
+    """
+    tok = tokens.astype(jnp.int32).reshape(-1, 1)
+    b = tok.shape[0]
+    block_kwargs = dict(
+        d_model=self.d_model, n_heads=self.n_heads, d_ff=self.d_ff,
+        attn_block=self.attn_block, attn_q_block=self.attn_q_block,
+        attn_impl=self.attn_impl, dtype=self.dtype,
+        param_dtype=self.param_dtype, decode=True,
+        decode_exact=self.decode_exact)
+    x = nn.Embed(self.vocab, self.d_model, name="embed",
+                 dtype=self.dtype, param_dtype=self.param_dtype)(tok)
+    pos_emb = self.param(
+        "pos_embedding",
+        nn.initializers.normal(0.02, self.param_dtype),
+        (self.max_len, self.d_model))
+    # Per-slot position row (ring-wrapped past max_len): the same table
+    # row the full forward adds at that position.
+    x = x + jnp.take(pos_emb, pos % self.max_len,
+                     axis=0)[:, None, :].astype(self.dtype)
+    if self.scan_layers:
+      blocks = nn.scan(
+          _Block,
+          variable_axes={"params": 0},
+          split_rngs={"params": True},
+          length=self.n_layers)(name="blocks", **block_kwargs)
+      (x, _), (ck, cv) = blocks((x, pos), (cache_k, cache_v))
+    else:
+      cks, cvs = [], []
+      for i in range(self.n_layers):
+        (x, _), (ck_i, cv_i) = _Block(name=f"block_{i}", **block_kwargs)(
+            (x, pos), (cache_k[i], cache_v[i]))
+        cks.append(ck_i)
+        cvs.append(cv_i)
+      ck, cv = jnp.stack(cks), jnp.stack(cvs)
+    x = nn.LayerNorm(name="ln_f", dtype=jnp.float32,
+                     param_dtype=self.param_dtype)(x)
+    w_head = self.param("lm_head", nn.initializers.lecun_normal(),
+                        (self.d_model, self.vocab), self.param_dtype)
     logits = x.astype(self.dtype) @ w_head.astype(self.dtype)
-    return logits, aux
+    return logits, (ck, cv)
 
 
 class TransformerLMModel(model_lib.Model):

@@ -551,6 +551,114 @@ def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
   return outs.swapaxes(0, 1).reshape(b, l, h, d).astype(q.dtype)
 
 
+def decode_attention(q, k, v, pos, block: Optional[int] = None,
+                     impl: str = "tiled", scale: Optional[float] = None,
+                     cpu_fallback: Optional[bool] = None,
+                     exact: bool = False, q_block: Optional[int] = None):
+  """Single-query attention over a KV ring buffer -- the serving decode
+  step's core (serving/decode.py threads the cache through it).
+
+  ``q`` is the current token's query, (B, 1, H, D); ``k``/``v`` are the
+  (B, T, H, D) ring buffers with the current token's K/V already
+  written; ``pos`` (B,) int32 is each slot's absolute position. A key
+  slot ``s`` participates iff ``s <= pos[b]`` -- masked slots
+  contribute EXACTLY zero on both paths (the ``_NEG`` -> zeroed-p /
+  exp-underflow arithmetic), so stale ring contents and a foreign
+  packed-prefill neighbor never perturb the result.
+
+  ``impl='tiled'`` runs the ``_block_update`` online softmax over
+  ``block``-sized key blocks; ``impl='flash'`` is the Pallas flash
+  kernel's decode mode on TPU (SegmentIds masking, q length 1) with the
+  :func:`full_attention`-style masked softmax as the CPU fallback --
+  the same fallback split as :func:`pallas_flash_attention`.
+
+  ``exact=True`` is the oracle mode: the single query is scattered into
+  a zero q tile of the FULL ring length and run through the exact
+  full-sequence attention program (:func:`blockwise_attention` /
+  :func:`full_attention` -- identical shapes, identical op schedule),
+  then its one row is gathered back. Per-row results of a fixed-shape
+  XLA program are deterministic and row-independent, so exact-mode
+  decode at position ``p`` is BIT-IDENTICAL to row ``p`` of the full
+  forward -- the KV-cache correctness contract tests/test_serving.py
+  pins. The fast default (``exact=False``) computes the 1-row program
+  instead; XLA schedules the (1, T) contraction differently from the
+  (T, T) one, so it agrees to float rounding (~1e-6 rel), not bitwise
+  -- ~T x cheaper, the production serving path.
+  """
+  b, tq, h, d = q.shape
+  t = k.shape[1]
+  scale = (1.0 / math.sqrt(d)) if scale is None else scale
+  if impl not in ("tiled", "flash"):
+    raise ValueError(f"impl must be 'tiled' or 'flash', got {impl!r}")
+  if exact:
+    # Scatter row clamped to the LAST ring row once pos wraps past the
+    # buffer: the causal mask at row t-1 admits every slot, which is
+    # exactly the fast path's valid set for a wrapped ring (all slots
+    # hold trailing-window keys). Below the wrap the row IS pos and
+    # the full-forward graph identity holds bitwise; past it the mode
+    # degrades to the same windowed semantics as the fast path.
+    rows = jnp.minimum(pos, t - 1)
+    qfull = jnp.zeros((b, t, h, d), q.dtype)
+    qfull = qfull.at[jnp.arange(b), rows].set(q[:, 0])
+    if impl == "flash":
+      # The kernel's own reference form (pallas_flash_attention's CPU
+      # fallback) -- the op graph the flash full forward executes off
+      # TPU, so the oracle holds where it can actually run.
+      out = full_attention(qfull, k, v, causal=True, scale=scale)
+    else:
+      blk = min(block or t, t)
+      out = blockwise_attention(qfull, k, v, block_size=blk, causal=True,
+                                scale=scale,
+                                q_block_size=min(q_block or blk, t))
+    return out[jnp.arange(b), rows][:, None]
+  kpos = jnp.arange(t)
+  if impl == "flash":
+    if cpu_fallback is None:
+      cpu_fallback = jax.default_backend() != "tpu"
+    if not cpu_fallback:
+      from jax.experimental.pallas.ops.tpu import flash_attention as fa
+      seg = fa.SegmentIds(
+          q=jnp.ones((b, tq), jnp.int32),
+          kv=(kpos[None, :] <= pos[:, None]).astype(jnp.int32))
+      blk = min(block or t, tq, t)
+      qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
+      out = fa.flash_attention(qt, kt, vt, None, seg, causal=False,
+                               sm_scale=scale,
+                               block_sizes=uniform_flash_block_sizes(blk))
+      return out.swapaxes(1, 2).astype(q.dtype)
+    # CPU fallback: the full_attention op sequence, row-for-row.
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    mask = (kpos[None, None, None, :] <= pos[:, None, None, None])
+    s = jnp.where(mask, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+  blk = min(block or t, t)
+  if t % blk != 0:
+    raise ValueError(f"cache length {t} not divisible by block {blk}")
+  nb = t // blk
+  kb = k.reshape(b, nb, blk, h, d).swapaxes(0, 1)
+  vb = v.reshape(b, nb, blk, h, d).swapaxes(0, 1)
+  m0 = jnp.full((b, h, tq), _NEG, jnp.float32)
+  l0 = jnp.zeros((b, h, tq), jnp.float32)
+  o0 = jnp.zeros((b, tq, h, d), jnp.float32)
+
+  def step(carry, inp):
+    j, kj, vj = inp
+    # Mask rebuilt per block from the scalar offset, exactly as the
+    # training path's _block_update_remat does; fully-masked blocks
+    # no-op bitwise (m stays, corr == 1, p == 0), which is why decode
+    # over the FULL ring matches the full forward's cond-skipped scan.
+    mask = (pos[:, None, None, None] >=
+            (j * blk + jnp.arange(blk))[None, None, None, :])
+    return _block_update(q, kj, vj, *carry, scale, mask), None
+
+  (m, l, o), _ = lax.scan(step, (m0, l0, o0), (jnp.arange(nb), kb, vb))
+  out = o / jnp.maximum(l, 1e-30).swapaxes(1, 2)[..., None]
+  return out.astype(q.dtype)
+
+
 def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
                       causal: bool = False,
                       scale: Optional[float] = None,

@@ -520,6 +520,46 @@ flags.DEFINE_string("aot_load_path", None,
                     "_preprocess_graph + forward-only loop).")
 flags.DEFINE_boolean("use_synthetic_gpu_images", False,
                      "(parity alias; synthetic data is data_dir=None)")
+# Serving engine (kf_benchmarks_tpu/serving/; bench.py --serving and
+# experiments/serving_sweep.py --engine consume these). All default
+# None = the engine's own defaults, so a non-serving run's config
+# fingerprint is untouched (fingerprints drop None fields).
+flags.DEFINE_string("serving_bucket_ladder", None,
+                    "Comma-separated ascending batch buckets the "
+                    "serving engine may compile decode/prefill "
+                    "executables at (serving/engine.py; e.g. "
+                    "'1,4,16,64'). The ladder BOUNDS the executable "
+                    "set -- the auditor's serving_decode golden and "
+                    "the compile-ledger e2e pin it. None = the engine "
+                    "default ladder.")
+flags.DEFINE_string("serving_batching", None,
+                    "Serving batch policy: 'continuous' (in-flight "
+                    "batching -- freed decode slots refill from the "
+                    "queue every step) or 'static' (batch-and-drain: "
+                    "admit a wave, decode to completion, then admit "
+                    "again -- the A/B baseline arm). None = "
+                    "continuous (validation.py).")
+flags.DEFINE_integer("serving_max_new_tokens", None,
+                     "Default per-request generation cap of the "
+                     "serving engine. None = the engine default.",
+                     lower_bound=1)
+flags.DEFINE_integer("serving_queue_depth", None,
+                     "Admission queue bound: a submit beyond this "
+                     "depth is REJECTED (first-class shed result + "
+                     "serving/shed metric, never an exception). None "
+                     "= the engine default.", lower_bound=1)
+flags.DEFINE_float("serving_ttft_slo_ms", None,
+                   "TTFT service-level objective in ms: a queued "
+                   "request older than this at coalesce time is "
+                   "EXPIRED (deadline shedding) instead of wasting a "
+                   "prefill it can no longer meet. None = no "
+                   "deadline.", lower_bound=0.0)
+flags.DEFINE_float("serving_tenant_tokens_per_s", None,
+                   "Per-tenant token-budget rate (prompt + generated "
+                   "tokens charged at submit against a token bucket): "
+                   "an over-budget request is REJECTED with the "
+                   "tenant_budget shed reason. None = unmetered.",
+                   lower_bound=0.0)
 # Distributed / cluster flags (ref :570-583).
 flags.DEFINE_enum("job_name", "", ("ps", "worker", "controller", ""),
                   "Job role for multi-process runs (ref :571-573).")

@@ -1,0 +1,31 @@
+"""Production serving path: continuous-batching AOT inference.
+
+The reference's inference story stops at ``--forward_only`` -- one
+static synthetic batch timed in a loop, no request path (ref:
+scripts/tf_cnn_benchmarks/benchmark_cnn.py:2405-2525 _preprocess_graph
+freeze/serve, flags :615-620 --trt_mode). This subpackage is the
+request-driven system on top of the pieces the repo already measures:
+
+* ``decode.py`` -- the KV-ring-buffer LM decode programs: packed
+  prefill (mixed-length prompts in ONE dispatch, riding
+  data/packing.py), the single-token decode step
+  (models/transformer_lm.py ``decode=True``; attention =
+  parallel/sequence.decode_attention -- the Pallas flash kernel's
+  decode mode on TPU, the blockwise/full schedule on CPU), greedy
+  sampling in-program, caches donated in place.
+* ``engine.py`` -- the host-side request engine: bounded bucket-ladder
+  executable cache (AOT ``jit(...).lower(...).compile()``, keyed on
+  ``analysis/baseline.config_fingerprint_key``), continuous in-flight
+  batching (freed slots refill every decode step) vs static
+  batch-and-drain, SLO-aware admission control (queue-depth rejection,
+  TTFT-deadline expiry, per-tenant token budgets), request spans on the
+  ``RunTrace`` timeline and ``serving/*`` metrics in the registry
+  schema.
+"""
+
+from kf_benchmarks_tpu.serving.decode import (  # noqa: F401
+    CacheState, LMSpec, decode_fn, decode_module, forward_module,
+    init_cache, init_variables, prefill_fn)
+from kf_benchmarks_tpu.serving.engine import (  # noqa: F401
+    EngineConfig, Request, RequestResult, ServingEngine, bucket_for,
+    poisson_workload)

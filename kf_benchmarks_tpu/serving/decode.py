@@ -1,0 +1,288 @@
+"""KV-cache LM decode programs for the serving engine.
+
+Device-side half of the serving path (ref: the reference's closest
+analog is the frozen forward-only loop, benchmark_cnn.py:2405-2525;
+everything autoregressive here is beyond-reference). Three programs,
+each compiled ahead of time per bucket by the engine:
+
+* **prefill** -- mixed-length prompts, first-fit packed into one
+  ``(B_pack, 3, T)`` stack (data/packing.py ``pack_prompts``), run
+  through the full-sequence forward with ``return_kv=True``: one
+  dispatch produces every prompt's first sampled token (from the fused
+  head's hidden states -- no (B, T, V) logits tensor ever exists) AND
+  its per-layer K/V span, which is sliced out of the packed rows and
+  installed into the ring-buffer cache slots in the same program.
+* **decode step** -- one token per active slot through the
+  ``decode=True`` transformer_lm path: write K/V into the ring at
+  ``pos``, attend over ``slot <= pos``, greedy-sample the next token
+  in-program. Caches are donated, so the step updates them in place --
+  the executable's only traffic is the (B,) token/pos vectors.
+* **cache state** -- the explicit ``(L, B, T, H, Dh)`` K/V ring
+  buffers plus per-slot ``pos``/``tok`` vectors; per-slot positions are
+  what lets continuous batching refill one freed slot while its
+  neighbors keep decoding.
+
+Numerical contract (tests/test_serving.py): with ``decode_exact=True``
+the per-token f32 logits of the incremental path are BIT-IDENTICAL to
+the full-sequence forward at every prefix length, for both the
+blockwise CPU schedule and the flash path's CPU reference (the masked
+positions contribute exactly zero; the exact mode reuses the full
+forward's op graph -- see sequence.decode_attention). The fast 1-row
+schedule agrees to float rounding (~2e-6 measured) and is the
+production default.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kf_benchmarks_tpu.models import transformer_lm as lm
+
+
+@dataclasses.dataclass(frozen=True)
+class LMSpec:
+  """The served LM's shape -- defaults are the zoo transformer_lm, so
+  a serving benchmark exercises the same program family the training
+  harness measures. ``max_len`` is both the ring-buffer length and the
+  packed-prefill width; prompts + generation beyond it fall into the
+  ring's sliding window."""
+  vocab: int = lm.VOCAB
+  d_model: int = lm.D_MODEL
+  n_layers: int = lm.N_LAYERS
+  n_heads: int = lm.N_HEADS
+  d_ff: int = lm.D_FF
+  max_len: int = lm.SEQ_LEN
+  attn_block: int = lm.ATTN_BLOCK
+  attn_impl: str = "tiled"
+  scan_layers: bool = True
+  decode_exact: bool = False
+  dtype: Any = jnp.float32
+  param_dtype: Any = jnp.float32
+
+  @property
+  def head_dim(self) -> int:
+    return self.d_model // self.n_heads
+
+  def config(self) -> dict:
+    """The fingerprint payload (analysis/baseline.config_fingerprint_key
+    keys the executable cache and compile ledger on it)."""
+    return {
+        "vocab": self.vocab, "d_model": self.d_model,
+        "n_layers": self.n_layers, "n_heads": self.n_heads,
+        "d_ff": self.d_ff, "max_len": self.max_len,
+        "attn_block": self.attn_block, "attn_impl": self.attn_impl,
+        "scan_layers": self.scan_layers,
+        "decode_exact": self.decode_exact,
+        "dtype": jnp.dtype(self.dtype).name,
+        "param_dtype": jnp.dtype(self.param_dtype).name,
+    }
+
+
+class CacheState(NamedTuple):
+  """The explicit ring-buffer decode state. ``k``/``v``:
+  (L, B, T, H, Dh); ``pos``: (B,) absolute position of each slot's
+  CURRENT token; ``tok``: (B,) the token at that position (not yet in
+  the cache -- the next decode step writes it)."""
+  k: Any
+  v: Any
+  pos: Any
+  tok: Any
+
+
+def _module_kwargs(spec: LMSpec) -> dict:
+  return dict(vocab=spec.vocab, d_model=spec.d_model,
+              n_layers=spec.n_layers, n_heads=spec.n_heads,
+              d_ff=spec.d_ff, attn_block=spec.attn_block,
+              attn_q_block=spec.attn_block, attn_impl=spec.attn_impl,
+              scan_layers=spec.scan_layers, max_len=spec.max_len,
+              dtype=spec.dtype, param_dtype=spec.param_dtype)
+
+
+def forward_module(spec: LMSpec, fused_head: bool = True,
+                   return_kv: bool = False):
+  """The full-sequence forward (prefill / oracle reference)."""
+  return lm._TransformerLMModule(fused_head=fused_head,
+                                 return_kv=return_kv,
+                                 **_module_kwargs(spec))
+
+
+def decode_module(spec: LMSpec):
+  """The single-token KV-ring decode module."""
+  return lm._TransformerLMModule(fused_head=False, decode=True,
+                                 decode_exact=spec.decode_exact,
+                                 **_module_kwargs(spec))
+
+
+def init_variables(spec: LMSpec, seed: int = 0):
+  """Synthetic serving weights (the engine serves frozen weights; any
+  checkpointed transformer_lm param tree of the same shape drops in)."""
+  module = forward_module(spec, fused_head=True)
+  rng = jax.random.PRNGKey(seed)
+  sample = jnp.zeros((1, spec.max_len), jnp.int32)
+  return module.init({"params": rng, "dropout": rng}, sample)
+
+
+def abstract_variables(spec: LMSpec):
+  """ShapeDtypeStruct variable tree (nothing executes) -- the AOT
+  lowering input and the auditor's tracing input."""
+  module = forward_module(spec, fused_head=True)
+  sample = jnp.zeros((1, spec.max_len), jnp.int32)
+  return jax.eval_shape(
+      lambda: module.init({"params": jax.random.PRNGKey(0),
+                           "dropout": jax.random.PRNGKey(0)}, sample))
+
+
+def init_cache(spec: LMSpec, bucket: int) -> CacheState:
+  shape = (spec.n_layers, bucket, spec.max_len, spec.n_heads,
+           spec.head_dim)
+  return CacheState(
+      k=jnp.zeros(shape, spec.dtype), v=jnp.zeros(shape, spec.dtype),
+      pos=jnp.zeros((bucket,), jnp.int32),
+      tok=jnp.zeros((bucket,), jnp.int32))
+
+
+def grow_cache(cache: CacheState, spec: LMSpec,
+               bucket: int) -> CacheState:
+  """Migrate a cache onto a wider bucket (ladder growth): old slots
+  keep their contents and positions, new slots start empty."""
+  fresh = init_cache(spec, bucket)
+  old = cache.k.shape[1]
+  return CacheState(
+      k=fresh.k.at[:, :old].set(cache.k),
+      v=fresh.v.at[:, :old].set(cache.v),
+      pos=fresh.pos.at[:old].set(cache.pos),
+      tok=fresh.tok.at[:old].set(cache.tok))
+
+
+def abstract_cache(spec: LMSpec, bucket: int) -> CacheState:
+  """ShapeDtypeStruct cache (no allocation) -- AOT lowering input."""
+  shape = (spec.n_layers, bucket, spec.max_len, spec.n_heads,
+           spec.head_dim)
+  return CacheState(
+      k=jax.ShapeDtypeStruct(shape, spec.dtype),
+      v=jax.ShapeDtypeStruct(shape, spec.dtype),
+      pos=jax.ShapeDtypeStruct((bucket,), jnp.int32),
+      tok=jax.ShapeDtypeStruct((bucket,), jnp.int32))
+
+
+def decode_lowering_args(spec: LMSpec, bucket: int):
+  """The ONE decode-step AOT lowering recipe: ``(fn, abstract_args,
+  donate_argnums)``. Shared by the engine's executable cache
+  (serving/engine._decode_exe) and the auditor's serving tracer
+  (analysis/contracts.trace_serving_contract), so the serving_decode
+  golden can never silently pin a program the engine no longer
+  compiles."""
+  cache = abstract_cache(spec, bucket)
+  args = (abstract_variables(spec), cache.k, cache.v, cache.pos,
+          cache.tok, jax.ShapeDtypeStruct((bucket,), jnp.bool_))
+  return decode_fn(spec), args, (1, 2)
+
+
+def decode_fn(spec: LMSpec):
+  """``(variables, k, v, pos, tok, active) -> (next_tok, k', v',
+  pos')`` -- one greedy decode step for every slot; inactive slots
+  hold their token and position (their ring writes land on a slot the
+  next prefill re-installs wholesale). The engine compiles this per
+  bucket with the caches donated."""
+  module = decode_module(spec)
+
+  def step(variables, cache_k, cache_v, pos, tok, active):
+    logits, (cache_k, cache_v) = module.apply(variables, tok, cache_k,
+                                              cache_v, pos)
+    nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+    nxt = jnp.where(active, nxt, tok)
+    pos = pos + active.astype(jnp.int32)
+    return nxt, cache_k, cache_v, pos
+
+  return step
+
+
+def prefill_fn(spec: LMSpec):
+  """``(variables, packed, rows, last_pos, offsets) -> (first_tok,
+  ek, ev)`` -- packed prefill, extract-only.
+
+  ``packed`` is the (B_pack, 3, T) stack from packing.pack_prompts;
+  per admitted request ``i``: ``rows[i]``/``offsets[i]`` locate its
+  span inside the packed batch, ``last_pos[i] = offsets[i] +
+  lengths[i] - 1`` its final prompt token. Returns each request's
+  first sampled token plus its extracted per-layer K/V span,
+  ring-length-padded -- (B_pack, L, T_cache, H, Dh). The engine
+  scatters the spans into decode slots with plain jnp ops
+  (``install_prefill``), which keeps this program keyed on the PACK
+  bucket alone: a one-request wave pays a one-row prefill even while
+  a wide decode bucket is in flight (the executable-set bound stays
+  <= len(ladder) per program family).
+
+  The fused head keeps the forward logits-free; only the (R, V) rows
+  at the prompts' final positions are ever materialized. Cache spans
+  are sliced STALE-INCLUSIVE: positions past a prompt's length hold a
+  packed neighbor's K/V until decode overwrites them, which the
+  ``slot <= pos`` attention mask makes exactly invisible
+  (sequence.decode_attention)."""
+  module = forward_module(spec, fused_head=True, return_kv=True)
+  t_cache = spec.max_len
+
+  def prefill(variables, packed, rows, last_pos, offsets):
+    head, _aux, (kst, vst) = module.apply(variables, packed)
+    # First sampled token per request: the dense head's row, computed
+    # only at the prompts' final positions (bit-identical to the
+    # full dense-head forward's row -- tests/test_serving.py).
+    hidden = head.hidden[rows, last_pos]              # (R, D)
+    logits = hidden @ head.kernel.astype(spec.dtype)  # (R, V)
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # Slice each request's K/V span out of its packed row. Padded
+    # along T so a tail span slices clean.
+    kp = jnp.pad(kst, ((0, 0), (0, 0), (0, t_cache), (0, 0), (0, 0)))
+    vp = jnp.pad(vst, ((0, 0), (0, 0), (0, t_cache), (0, 0), (0, 0)))
+    l_, h_, d_ = kst.shape[0], kst.shape[3], kst.shape[4]
+
+    def span(arr, row, off):
+      sl = lax.dynamic_slice(arr, (0, row, off, 0, 0),
+                             (l_, 1, t_cache, h_, d_))
+      return sl[:, 0]
+
+    ek = jax.vmap(span, in_axes=(None, 0, 0))(kp, rows, offsets)
+    ev = jax.vmap(span, in_axes=(None, 0, 0))(vp, rows, offsets)
+    return first, ek, ev
+
+  return prefill
+
+
+def install_prefill(cache: CacheState, ek, ev, first, lengths,
+                    slots) -> CacheState:
+  """Scatter prefilled spans into their decode slots (plain jnp ops;
+  out-of-range slot indices -- padding entries -- drop). ``ek``/``ev``
+  are prefill_fn's (B_pack, L, T, H, Dh) extracts."""
+  return CacheState(
+      k=cache.k.at[:, slots].set(jnp.moveaxis(ek, 0, 1), mode="drop"),
+      v=cache.v.at[:, slots].set(jnp.moveaxis(ev, 0, 1), mode="drop"),
+      pos=cache.pos.at[slots].set(lengths, mode="drop"),
+      tok=cache.tok.at[slots].set(first, mode="drop"))
+
+
+def reference_generate(spec: LMSpec, variables, prompt,
+                       max_new_tokens: int) -> Tuple[Any, Any]:
+  """Greedy generation straight through the full-sequence forward --
+  the engine-free oracle the e2e tests compare engine output against.
+  O(T^2) per token; test instrument only. Returns (first_token,
+  [all generated tokens])."""
+  module = forward_module(spec, fused_head=False)
+  apply = jax.jit(module.apply)
+  out = []
+  toks = list(int(t) for t in jnp.asarray(prompt))
+  for _ in range(max_new_tokens):
+    # Fixed (1, max_len) shape (zero-padded tail): causal attention
+    # makes the pad rows invisible to position len-1, and the fixed
+    # shape keeps the tiled path's block divisibility and ONE compile.
+    batch = jnp.zeros((1, spec.max_len), jnp.int32)
+    batch = batch.at[0, :len(toks)].set(jnp.asarray(toks, jnp.int32))
+    logits, _ = apply(variables, batch)
+    nxt = int(jnp.argmax(logits[0, len(toks) - 1]))
+    out.append(nxt)
+    toks.append(nxt)
+  return (out[0] if out else None), out

@@ -1,0 +1,615 @@
+"""Request-driven serving engine: continuous batching + admission control.
+
+Host-side half of the serving path (device programs: decode.py). The
+reference never had a request path at all (its serving story is the
+frozen forward-only loop, ref: benchmark_cnn.py:2405-2525); this engine
+turns request ARRIVALS into device throughput:
+
+* **Bounded executable set** -- decode/prefill programs exist only at
+  bucket-ladder batch widths (default 1/4/16/64/256), AOT-compiled via
+  ``jit(...).lower(...).compile()`` once per bucket and cached keyed on
+  ``analysis/baseline.config_fingerprint_key``; every compile lands in
+  the run-trace compile ledger, which is how the e2e test pins
+  "<= len(ladder) decode compiles across a mixed-length replay"
+  (tests/test_serving.py).
+* **Continuous in-flight batching** -- freed slots refill from the
+  queue every decode step (``batching='continuous'``); the A/B arm
+  ``'static'`` is classic batch-and-drain: admit a wave, decode it to
+  completion, only then admit again (experiments/serving_sweep.py
+  measures the p99-TTFT gap between the two at fixed offered load).
+* **SLO-aware admission** -- queue-depth rejection at submit,
+  TTFT-deadline expiry at coalesce time, and a per-tenant token-bucket
+  budget; rejected/expired requests are first-class results and
+  ``serving/*`` metrics, never exceptions.
+* **Observability joins** -- request spans (enqueue -> coalesce ->
+  prefill -> decode -> done) land on the active ``RunTrace`` timeline
+  ("serving" lane); TTFT / per-token latency ride ``add_sample`` into
+  the standard percentile machinery; counters/gauges go through the
+  registered ``serving/*`` schema keys (metrics.py). Decode-step
+  device time is attributed from completion-to-completion intervals
+  (the token fetch is a value dependency) -- never
+  ``jax.block_until_ready`` (utils/sync.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kf_benchmarks_tpu import metrics as metrics_lib
+from kf_benchmarks_tpu import tracing as tracing_lib
+from kf_benchmarks_tpu.serving import decode as decode_lib
+
+DEFAULT_BUCKET_LADDER = (1, 4, 16, 64, 256)
+
+
+def bucket_for(n: int, ladder: Sequence[int]) -> int:
+  """Smallest ladder bucket >= n (the top bucket when n overflows)."""
+  for b in ladder:
+    if n <= b:
+      return b
+  return ladder[-1]
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+  spec: decode_lib.LMSpec = dataclasses.field(
+      default_factory=decode_lib.LMSpec)
+  bucket_ladder: Tuple[int, ...] = DEFAULT_BUCKET_LADDER
+  batching: str = "continuous"       # or "static" (batch-and-drain)
+  max_new_tokens: int = 32           # default per-request cap
+  max_queue_depth: int = 64          # submit-time rejection bound
+  ttft_slo_s: Optional[float] = None  # default TTFT deadline (expiry)
+  tenant_tokens_per_s: Optional[float] = None  # None = unmetered
+  tenant_burst_s: float = 4.0        # token-bucket burst window
+
+  def __post_init__(self):
+    ladder = tuple(sorted(set(int(b) for b in self.bucket_ladder)))
+    if not ladder or ladder[0] < 1:
+      raise ValueError(f"bucket ladder must be positive ints, got "
+                       f"{self.bucket_ladder}")
+    object.__setattr__(self, "bucket_ladder", ladder)
+    if self.batching not in ("continuous", "static"):
+      raise ValueError(f"batching must be 'continuous' or 'static', "
+                       f"got {self.batching!r}")
+
+  def fingerprint_config(self, bucket: int, program: str) -> dict:
+    """The executable-cache / compile-ledger key payload: the served
+    model's shape plus the one shape knob (the bucket)."""
+    return {**self.spec.config(), "bucket": int(bucket),
+            "serving_program": program}
+
+
+@dataclasses.dataclass
+class Request:
+  rid: Any
+  prompt: Any                         # 1-D int32 token array
+  max_new_tokens: Optional[int] = None
+  tenant: str = "default"
+  deadline_s: Optional[float] = None  # TTFT deadline (engine default
+                                      # applies when None)
+  enqueue_t: Optional[float] = None   # stamped by submit()
+
+
+@dataclasses.dataclass
+class RequestResult:
+  rid: Any
+  tenant: str
+  status: str                         # ok | rejected | expired
+  tokens: List[int] = dataclasses.field(default_factory=list)
+  ttft_s: Optional[float] = None
+  total_s: Optional[float] = None
+  shed_reason: Optional[str] = None
+
+
+class ServingEngine:
+  """One-process serving loop over the decode.py programs.
+
+  Synchronous by design: callers drive it with ``submit`` + ``drain``
+  (tests) or ``replay(workload)`` (bench/sweep -- wall-clock arrival
+  offsets). TPU discipline: ONE engine per process, programs dispatched
+  strictly serially, results awaited by value dependency.
+  """
+
+  def __init__(self, config: EngineConfig, variables=None,
+               seed: int = 0, time_fn=time.monotonic,
+               sleep_fn=time.sleep):
+    self.cfg = config
+    self.spec = config.spec
+    self._time = time_fn
+    self._sleep = sleep_fn
+    self.variables = (variables if variables is not None
+                      else decode_lib.init_variables(self.spec, seed))
+    self._queue: collections.deque = collections.deque()
+    self._results: Dict[Any, RequestResult] = {}
+    self._order: List[Any] = []
+    self._bucket = 0
+    self._cache: Optional[decode_lib.CacheState] = None
+    self._slots: List[Optional[dict]] = []
+    self._decode_exes: Dict[int, Any] = {}
+    self._prefill_exes: Dict[int, Any] = {}
+    self._arrivals = 0
+    self._shed = 0
+    self._completed = 0
+    self._decode_steps = 0
+    self._tokens_out = 0
+    self._fill_sum = 0.0
+    self._queue_depth_sum = 0.0
+    self._ticks = 0
+    self._ttfts: List[float] = []
+    self._token_lat: List[float] = []
+    self._tenant_allowance: Dict[str, float] = {}
+    self._tenant_last: Dict[str, float] = {}
+    self._t_serve0: Optional[float] = None
+    self._t_serve1: Optional[float] = None
+    self._last_step_t: Optional[float] = None
+    self.state = "idle"
+
+  # -- admission --------------------------------------------------------------
+
+  def submit(self, req: Request) -> bool:
+    """Enqueue one request; returns False when admission shed it
+    (queue depth / tenant budget) -- the shed is a RESULT, not an
+    exception. A pre-stamped ``enqueue_t`` is honored (replay stamps
+    the SCHEDULED arrival time, so TTFT and deadline expiry include
+    any wait behind an in-flight decode step -- the coordinated-
+    omission trap); direct callers get stamped here."""
+    now = self._time()
+    if req.enqueue_t is None:
+      req.enqueue_t = now
+    self._arrivals += 1
+    reg = metrics_lib.active()
+    reg.inc("serving/requests")
+    if len(self._queue) >= self.cfg.max_queue_depth:
+      self._shed_request(req, "queue_depth")
+      return False
+    prompt_len = int(np.asarray(req.prompt).size)
+    if prompt_len < 1:
+      self._shed_request(req, "empty_prompt")
+      return False
+    if prompt_len > self.spec.max_len:
+      self._shed_request(req, "prompt_too_long")
+      return False
+    tokens = prompt_len + self._max_new(req)
+    if not self._tenant_admit(req.tenant, tokens, now):
+      self._shed_request(req, "tenant_budget")
+      return False
+    tracing_lib.active().instant("serving", "enqueue", rid=str(req.rid),
+                                 tenant=req.tenant)
+    self._queue.append(req)
+    return True
+
+  def _max_new(self, req: Request) -> int:
+    return int(req.max_new_tokens or self.cfg.max_new_tokens)
+
+  def _deadline(self, req: Request) -> Optional[float]:
+    return (req.deadline_s if req.deadline_s is not None
+            else self.cfg.ttft_slo_s)
+
+  def _tenant_admit(self, tenant: str, tokens: int, now: float) -> bool:
+    rate = self.cfg.tenant_tokens_per_s
+    if rate is None:
+      return True
+    burst = rate * self.cfg.tenant_burst_s
+    allowance = self._tenant_allowance.get(tenant, burst)
+    last = self._tenant_last.get(tenant, now)
+    allowance = min(burst, allowance + (now - last) * rate)
+    self._tenant_last[tenant] = now
+    if tokens > allowance:
+      self._tenant_allowance[tenant] = allowance
+      return False
+    self._tenant_allowance[tenant] = allowance - tokens
+    return True
+
+  def _shed_request(self, req: Request, reason: str,
+                    status: str = "rejected") -> None:
+    self._shed += 1
+    reg = metrics_lib.active()
+    reg.inc("serving/shed")
+    tracing_lib.active().instant("serving", "shed", rid=str(req.rid),
+                                 reason=reason)
+    self._record(RequestResult(rid=req.rid, tenant=req.tenant,
+                               status=status, shed_reason=reason))
+
+  def _record(self, result: RequestResult) -> None:
+    if result.rid not in self._results:
+      self._order.append(result.rid)
+    self._results[result.rid] = result
+
+  # -- executable cache (the bounded set) -------------------------------------
+
+  def _compile(self, kind: str, bucket: int, fn, abstract_args,
+               donate) -> Any:
+    from kf_benchmarks_tpu.analysis import baseline as baseline_lib
+    import jax
+    key = baseline_lib.config_fingerprint_key(
+        self.cfg.fingerprint_config(bucket, kind), program=kind)
+    t0 = time.monotonic()
+    compiled = jax.jit(fn, donate_argnums=donate).lower(
+        *abstract_args).compile()
+    tracing_lib.active().note_compile(key, kind,
+                                      time.monotonic() - t0,
+                                      bucket=bucket)
+    return compiled
+
+  def _decode_exe(self, bucket: int):
+    if bucket not in self._decode_exes:
+      fn, args, donate = decode_lib.decode_lowering_args(self.spec,
+                                                         bucket)
+      self._decode_exes[bucket] = self._compile(
+          "serving_decode", bucket, fn, args, donate=donate)
+    return self._decode_exes[bucket]
+
+  def _prefill_exe(self, bucket: int):
+    # Keyed on the PACK bucket (the wave size), independent of the
+    # decode bucket: a one-request refill wave pays a one-row packed
+    # forward even while a wide decode batch is in flight.
+    if bucket not in self._prefill_exes:
+      import jax
+      spec = self.spec
+      var_sds = decode_lib.abstract_variables(spec)
+      i32 = lambda: jax.ShapeDtypeStruct((bucket,), np.int32)
+      args = (var_sds,
+              jax.ShapeDtypeStruct((bucket, 3, spec.max_len), np.int32),
+              i32(), i32(), i32())
+      self._prefill_exes[bucket] = self._compile(
+          "serving_prefill", bucket, decode_lib.prefill_fn(spec), args,
+          donate=())
+    return self._prefill_exes[bucket]
+
+  def warm(self, buckets: Optional[Sequence[int]] = None) -> int:
+    """Precompile the decode + prefill executables for ``buckets``
+    (default: the whole ladder) BEFORE serving -- the `analysis warm`
+    discipline applied to the request path, so the first wave's TTFT
+    measures the system, not XLA. Returns the number of executables
+    compiled."""
+    n = 0
+    for b in (buckets if buckets is not None else self.cfg.bucket_ladder):
+      b = bucket_for(int(b), self.cfg.bucket_ladder)
+      before = len(self._decode_exes) + len(self._prefill_exes)
+      self._decode_exe(b)
+      self._prefill_exe(b)
+      n += len(self._decode_exes) + len(self._prefill_exes) - before
+    return n
+
+  # -- the serving loop -------------------------------------------------------
+
+  def _active_count(self) -> int:
+    return sum(1 for s in self._slots if s is not None)
+
+  def _ensure_bucket(self, target: int) -> None:
+    want = bucket_for(target, self.cfg.bucket_ladder)
+    if want <= self._bucket:
+      return
+    if self._cache is None:
+      self._cache = decode_lib.init_cache(self.spec, want)
+    else:
+      self._cache = decode_lib.grow_cache(self._cache, self.spec, want)
+    self._slots.extend([None] * (want - self._bucket))
+    self._bucket = want
+    metrics_lib.active().set("serving/decode_bucket", want)
+
+  def _maybe_shrink(self) -> None:
+    """Compact the decode batch DOWN the ladder when occupancy drops:
+    a decode step costs ~O(bucket) host/device work, so dragging a
+    wide bucket at low fill taxes every remaining token (measured on
+    the CPU-mesh A/B). Active slots compact to the front; an empty
+    engine drops its cache entirely so the next wave sizes itself.
+    The ladder's spacing is the hysteresis -- a shrink only fires when
+    occupancy fits a strictly lower bucket."""
+    if self._bucket == 0:
+      return
+    active_idx = [i for i, s in enumerate(self._slots) if s is not None]
+    if not active_idx:
+      self._bucket = 0
+      self._cache = None
+      self._slots = []
+      metrics_lib.active().set("serving/decode_bucket", 0)
+      return
+    target = bucket_for(len(active_idx), self.cfg.bucket_ladder)
+    if target >= self._bucket:
+      return
+    import jax.numpy as jnp
+    # Pad rows duplicate slot 0's cache; they carry active=False, so
+    # their contents are never read and their writes land on the pad
+    # row only.
+    keep = jnp.asarray(
+        active_idx + [0] * (target - len(active_idx)), jnp.int32)
+    cache = self._cache
+    self._cache = decode_lib.CacheState(
+        k=cache.k[:, keep], v=cache.v[:, keep],
+        pos=cache.pos[keep], tok=cache.tok[keep])
+    self._slots = ([self._slots[i] for i in active_idx]
+                   + [None] * (target - len(active_idx)))
+    self._bucket = target
+    metrics_lib.active().set("serving/decode_bucket", target)
+
+  def _coalesce(self, now: float) -> List[Request]:
+    """Pop admitted work for this wave: expired requests shed here
+    (deadline-based shedding), live ones admitted up to the ladder
+    headroom left by in-flight slots."""
+    headroom = self.cfg.bucket_ladder[-1] - self._active_count()
+    wave: List[Request] = []
+    while self._queue and len(wave) < headroom:
+      req = self._queue.popleft()
+      deadline = self._deadline(req)
+      if deadline is not None and now - req.enqueue_t > deadline:
+        self._shed_request(req, "ttft_deadline", status="expired")
+        continue
+      wave.append(req)
+    return wave
+
+  def _prefill_wave(self, wave: List[Request]) -> None:
+    from kf_benchmarks_tpu.data import packing as packing_lib
+    import jax.numpy as jnp
+    self._ensure_bucket(self._active_count() + len(wave))
+    free = [i for i, s in enumerate(self._slots) if s is None]
+    # Pack bucket = the wave's own ladder size (rows <= prompts always
+    # suffice: every prompt fits one row).
+    pack_bucket = bucket_for(len(wave), self.cfg.bucket_ladder)
+    prompts = [np.asarray(r.prompt, np.int32) for r in wave]
+    packed_np, placements = packing_lib.pack_prompts(
+        prompts, self.spec.max_len, pack_bucket)
+    placed: List[Tuple[Request, np.ndarray, Tuple[int, int]]] = []
+    overflow: List[Request] = []
+    for req, prm, place in zip(wave, prompts, placements):
+      if place is None or len(placed) >= min(len(free), pack_bucket):
+        overflow.append(req)
+      else:
+        placed.append((req, prm, place))
+    # Requests that did not fit this wave's packed batch go back to
+    # the queue HEAD in order (near-FIFO, like the packer's lookahead).
+    for req in reversed(overflow):
+      self._queue.appendleft(req)
+    if not placed:
+      return
+    r = len(placed)
+    rows = np.zeros((pack_bucket,), np.int32)
+    offsets = np.zeros((pack_bucket,), np.int32)
+    last_pos = np.zeros((pack_bucket,), np.int32)
+    lengths = np.zeros((pack_bucket,), np.int32)
+    slots = np.full((pack_bucket,), self._bucket, np.int32)  # pad drops
+    for i, (req, prm, (row, off)) in enumerate(placed):
+      rows[i], offsets[i] = row, off
+      lengths[i] = prm.size
+      last_pos[i] = off + prm.size - 1
+      slots[i] = free[i]
+    exe = self._prefill_exe(pack_bucket)
+    trace = tracing_lib.active()
+    with trace.span("serving", "prefill", requests=r,
+                    bucket=pack_bucket):
+      first, ek, ev = exe(self.variables, jnp.asarray(packed_np),
+                          jnp.asarray(rows), jnp.asarray(last_pos),
+                          jnp.asarray(offsets))
+      self._cache = decode_lib.install_prefill(
+          self._cache, ek, ev, first, jnp.asarray(lengths),
+          jnp.asarray(slots))
+      first_np = np.asarray(first)  # value dependency = completion
+    now = self._time()
+    for i, (req, prm, _place) in enumerate(placed):
+      ttft = now - req.enqueue_t
+      self._ttfts.append(ttft)
+      trace.add_sample("serving/ttft", ttft)
+      slot = {"req": req, "tokens": [int(first_np[i])],
+              "t_first": now, "ttft": ttft}
+      self._slots[free[i]] = slot
+      if len(slot["tokens"]) >= self._max_new(req):
+        self._complete(free[i], now)
+    self._tokens_out += r
+
+  def _decode_step(self) -> None:
+    import jax.numpy as jnp
+    bucket = self._bucket
+    active_np = np.array([s is not None for s in self._slots], np.bool_)
+    exe = self._decode_exe(bucket)
+    cache = self._cache
+    trace = tracing_lib.active()
+    t0 = self._time()
+    with trace.span("serving", "decode_step",
+                    active=int(active_np.sum()), bucket=bucket):
+      nxt, k, v, pos = exe(self.variables, cache.k, cache.v, cache.pos,
+                           cache.tok, jnp.asarray(active_np))
+      nxt_np = np.asarray(nxt)  # value dependency = completion
+    now = self._time()
+    step_wall = now - t0
+    self._cache = decode_lib.CacheState(k=k, v=v, pos=pos,
+                                        tok=jnp.asarray(nxt))
+    self._decode_steps += 1
+    self._last_step_t = now
+    n_active = int(active_np.sum())
+    self._fill_sum += n_active / max(bucket, 1)
+    self._tokens_out += n_active
+    trace.add_sample("serving/token_latency", step_wall)
+    self._token_lat.append(step_wall)
+    reg = metrics_lib.active()
+    reg.inc("serving/decode_steps")
+    reg.set("serving/active", n_active)
+    for i, slot in enumerate(self._slots):
+      if slot is None:
+        continue
+      slot["tokens"].append(int(nxt_np[i]))
+      if len(slot["tokens"]) >= self._max_new(slot["req"]):
+        self._complete(i, now)
+
+  def _complete(self, slot_idx: int, now: float) -> None:
+    slot = self._slots[slot_idx]
+    self._slots[slot_idx] = None
+    req = slot["req"]
+    self._completed += 1
+    metrics_lib.active().inc("serving/completed")
+    result = RequestResult(
+        rid=req.rid, tenant=req.tenant, status="ok",
+        tokens=list(slot["tokens"]), ttft_s=slot["ttft"],
+        total_s=now - req.enqueue_t)
+    self._record(result)
+    trace = tracing_lib.active()
+    # Retrospective whole-request span: enqueue -> completion, on the
+    # trace clock (requests were stamped with self._time; translate by
+    # the shared monotonic origin only when the clocks coincide).
+    trace.add_span("serving", "request", trace.now() - result.total_s,
+                   result.total_s,
+                   {"rid": str(req.rid), "status": "ok",
+                    "ttft_s": round(result.ttft_s, 6),
+                    "tokens": len(result.tokens)})
+
+  def _tick(self) -> None:
+    self._ticks += 1
+    reg = metrics_lib.active()
+    self._queue_depth_sum += len(self._queue)
+    reg.set("serving/queue_depth", len(self._queue))
+    self._maybe_shrink()
+    now = self._time()
+    admit = bool(self._queue) and (
+        self.cfg.batching == "continuous" or self._active_count() == 0)
+    if admit:
+      wave = self._coalesce(now)
+      if wave:
+        self._prefill_wave(wave)
+    if self._active_count():
+      self._decode_step()
+
+  def drain(self) -> List[RequestResult]:
+    """Serve until queue and slots are empty; returns every result so
+    far in submission order."""
+    self.state = "running"
+    if self._t_serve0 is None:
+      self._t_serve0 = self._time()
+    while self._queue or self._active_count():
+      self._tick()
+    self._t_serve1 = self._time()
+    self.state = "drained"
+    self._publish()
+    return self.results()
+
+  def replay(self, workload: Sequence[Tuple[float, Request]]
+             ) -> List[RequestResult]:
+    """Replay a seeded workload of (arrival_offset_s, request) pairs in
+    wall time: requests become visible at their offsets, the loop
+    decodes continuously in between (idle gaps sleep until the next
+    arrival). The replayable-trace form bench.py --serving and
+    experiments/serving_sweep.py drive."""
+    self.state = "running"
+    pending = collections.deque(
+        sorted(workload, key=lambda pair: pair[0]))
+    start = self._time()
+    self._t_serve0 = start
+    while pending or self._queue or self._active_count():
+      now = self._time() - start
+      while pending and pending[0][0] <= now:
+        offset, req = pending.popleft()
+        # The SCHEDULED arrival is the enqueue time: a request whose
+        # offset fell while a decode step was in flight has already
+        # been waiting, and its TTFT/deadline clock must say so.
+        req.enqueue_t = start + offset
+        self.submit(req)
+      if not self._queue and not self._active_count() and pending:
+        self._sleep(max(0.0, pending[0][0] - (self._time() - start)))
+        continue
+      self._tick()
+    self._t_serve1 = self._time()
+    self.state = "drained"
+    self._publish()
+    return self.results()
+
+  def results(self) -> List[RequestResult]:
+    return [self._results[rid] for rid in self._order]
+
+  # -- reporting --------------------------------------------------------------
+
+  def healthz(self) -> Dict[str, Any]:
+    """Engine liveness for the /healthz endpoint (metrics.py
+    MetricsServer healthz_fn)."""
+    return {
+        "status": "ok",
+        "serving": {
+            "state": self.state,
+            "active": self._active_count(),
+            "queue_depth": len(self._queue),
+            "bucket": self._bucket,
+            "completed": self._completed,
+            "shed": self._shed,
+            "decode_steps": self._decode_steps,
+        },
+    }
+
+  def serve_metrics(self, port: int, registry=None,
+                    host: str = "127.0.0.1"):
+    """Bind the live /metrics + /healthz endpoint for this engine."""
+    return metrics_lib.MetricsServer(
+        registry if registry is not None else metrics_lib.active(),
+        port, host=host, healthz_fn=self.healthz)
+
+  def stats(self) -> Dict[str, Any]:
+    """Flat registered-key stats of the run so far (the bench.py
+    --serving JSON payload; every key lives in metrics.SCHEMA)."""
+    wall = None
+    if self._t_serve0 is not None and self._t_serve1 is not None:
+      wall = max(self._t_serve1 - self._t_serve0, 1e-9)
+    pct = tracing_lib.percentile
+    out = {
+        "serving/requests": self._arrivals,
+        "serving/completed": self._completed,
+        "serving/shed": self._shed,
+        "serving/shed_fraction": (self._shed / self._arrivals
+                                  if self._arrivals else 0.0),
+        "serving/decode_steps": self._decode_steps,
+        "serving/decode_bucket": self._bucket,
+        "serving/batch_fill_fraction": (
+            self._fill_sum / self._decode_steps
+            if self._decode_steps else None),
+        "serving/queue_depth": (self._queue_depth_sum / self._ticks
+                                if self._ticks else None),
+        "serving/tokens_per_sec": (self._tokens_out / wall
+                                   if wall else None),
+        "serving/ttft_p50": pct(self._ttfts, 50),
+        "serving/ttft_p90": pct(self._ttfts, 90),
+        "serving/ttft_p99": pct(self._ttfts, 99),
+        "serving/token_latency_p50": pct(self._token_lat, 50),
+        "serving/token_latency_p90": pct(self._token_lat, 90),
+        "serving/token_latency_p99": pct(self._token_lat, 99),
+    }
+    return out
+
+  def _publish(self) -> None:
+    reg = metrics_lib.active()
+    for key, value in self.stats().items():
+      if value is None:
+        continue
+      if metrics_lib.SCHEMA[key].kind == "counter":
+        continue  # counters were incremented live
+      reg.set(key, value)
+
+
+# -- replayable workloads -----------------------------------------------------
+
+def poisson_workload(n: int, rate_per_s: float, spec: decode_lib.LMSpec,
+                     seed: int = 0, max_new_tokens: int = 16,
+                     mean_prompt_fraction: float = 0.2,
+                     tenants: Sequence[str] = ("default",)
+                     ) -> List[Tuple[float, Request]]:
+  """A seeded, replayable open-loop arrival trace: exponential
+  inter-arrivals at ``rate_per_s``, lognormal prompt lengths
+  (data/packing.py's document-length shape, scaled down so prompts +
+  generation fit the ring), tenants round-robin. Same seed => same
+  workload, the A/B and regression-comparison contract."""
+  from kf_benchmarks_tpu.data import packing as packing_lib
+  rng = np.random.default_rng(seed)
+  cap = max(1, spec.max_len - max_new_tokens - 1)
+  lengths = np.minimum(
+      packing_lib.sample_document_lengths(
+          rng, n, spec.max_len, mean_fraction=mean_prompt_fraction),
+      cap)
+  gaps = rng.exponential(1.0 / max(rate_per_s, 1e-9), size=n)
+  t = np.cumsum(gaps)
+  out = []
+  for i in range(n):
+    prompt = rng.integers(0, spec.vocab, size=int(lengths[i]),
+                          dtype=np.int32)
+    out.append((float(t[i]), Request(
+        rid=i, prompt=prompt, max_new_tokens=max_new_tokens,
+        tenant=tenants[i % len(tenants)])))
+  return out

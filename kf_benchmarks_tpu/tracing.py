@@ -66,12 +66,18 @@ from typing import Any, Dict, List, Optional
 
 # Subsystem lanes (Chrome tid; one timeline row per subsystem under
 # each rank's pid). Order fixes the tid numbering so merged multi-rank
-# timelines line up row-for-row.
+# timelines line up row-for-row. "serving" is the request engine's lane
+# (serving/engine.py: enqueue/shed instants, prefill/decode-step spans,
+# whole-request spans).
 SUBSYSTEMS = ("run", "compile", "dispatch", "device", "feed",
-              "checkpoint", "eval", "elastic", "faults", "profiler")
+              "checkpoint", "eval", "elastic", "faults", "profiler",
+              "serving")
 
 # Canonical latency-sample keys (the percentile lines / stats fields).
-SAMPLE_KEYS = ("chunk_wall", "feed_wait", "checkpoint_save")
+# The serving/* pair comes from the request engine: TTFT per request,
+# decode-step wall per emitted token (serving/engine.py).
+SAMPLE_KEYS = ("chunk_wall", "feed_wait", "checkpoint_save",
+               "serving/ttft", "serving/token_latency")
 
 # Reported quantiles. Every ``<key>_p<q>`` stats/bench-JSON field is
 # SAMPLE_KEYS x QUANTILES; the metric registry (metrics.py) registers

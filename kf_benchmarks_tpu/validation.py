@@ -34,6 +34,24 @@ def parse_mesh_shape(mesh_shape: str):
   return dims[0], dims[1]
 
 
+def parse_bucket_ladder(ladder: str):
+  """'1,4,16,64' -> (1, 4, 16, 64): strictly ascending positive ints
+  (ParamError otherwise). Pure (no jax): callable from validation and
+  from bench.py / the serving sweep when they build an EngineConfig."""
+  parts = [s.strip() for s in str(ladder).split(",") if s.strip()]
+  try:
+    buckets = tuple(int(v) for v in parts)
+  except ValueError:
+    buckets = ()
+  if not buckets or any(b < 1 for b in buckets) or \
+      list(buckets) != sorted(set(buckets)):
+    raise ParamError(
+        f"--serving_bucket_ladder={ladder!r}: expected strictly "
+        "ascending positive integers (e.g. '1,4,16,64'); the ladder "
+        "bounds the serving engine's executable set")
+  return buckets
+
+
 # Flags with NO cross-flag constraint, each with the reason -- the
 # explicit no-validation marker the hazard lint requires (analysis/
 # lint.py rule 'flag-validation'): every flag in the params registry
@@ -197,6 +215,18 @@ def validate_cross_flags(params) -> None:
   if p.num_batches is not None and p.num_epochs is not None:
     raise ParamError("At most one of --num_batches and --num_epochs may be "
                      "set (ref :1300-1303)")
+  # Serving-engine knobs (bench.py --serving / serving_sweep --engine):
+  # value-validated here so a bad ladder or policy fails at parse time,
+  # not mid-serve. serving_max_new_tokens / serving_queue_depth /
+  # serving_ttft_slo_ms / serving_tenant_tokens_per_s carry their whole
+  # contract in the registry bounds (lower_bound), nothing to cross.
+  if getattr(p, "serving_bucket_ladder", None):
+    parse_bucket_ladder(p.serving_bucket_ladder)
+  batching = getattr(p, "serving_batching", None)
+  if batching is not None and batching not in ("continuous", "static"):
+    raise ParamError(
+        f"--serving_batching={batching!r}: expected 'continuous' "
+        "(in-flight batching) or 'static' (batch-and-drain)")
   if p.num_batches is not None and p.num_batches <= 0:
     raise ParamError("--num_batches must be positive")
   if (getattr(p, "steps_per_dispatch", 1) or 1) > 1:
