@@ -59,6 +59,19 @@ def main(argv=None):
                            "flag passthrough")
   parser.add_argument("--serving_batching", default=None,
                       help="serving: continuous | static")
+  parser.add_argument("--serving_quantize", default=None,
+                      choices=("int8",),
+                      help="serving: INT8 weight-only decode "
+                           "(--serving_quantize params passthrough)")
+  parser.add_argument("--serving_kv_page_size", type=int, default=None,
+                      help="serving: paged KV cache block size "
+                           "(must divide the spec's max_len)")
+  parser.add_argument("--serving_speculative_k", type=int, default=None,
+                      help="serving: speculative decoding draft length "
+                           "(>= 2; requires --serving_draft_layers)")
+  parser.add_argument("--serving_draft_layers", type=int, default=None,
+                      help="serving: draft model depth for speculative "
+                           "decoding (< the spec's n_layers)")
   parser.add_argument("--metrics_port", type=int, default=None,
                       help="serving: bind the live /metrics + /healthz "
                            "endpoint for the duration of the replay")
@@ -267,14 +280,33 @@ def run_serving_bench(args, on_tpu, attempts) -> int:
       model="transformer_lm", device="tpu" if on_tpu else "cpu",
       num_devices=1,
       serving_bucket_ladder=args.serving_bucket_ladder,
-      serving_batching=args.serving_batching)
+      serving_batching=args.serving_batching,
+      serving_quantize=args.serving_quantize,
+      serving_kv_page_size=args.serving_kv_page_size,
+      serving_speculative_k=args.serving_speculative_k,
+      serving_draft_layers=args.serving_draft_layers)
+  # Cross-flag contract (validation.py): an inconsistent variant combo
+  # (speculative without a draft, a non-dividing page size) fails at
+  # parse time with the named flag, not mid-serve inside LMSpec.
+  validation.validate_cross_flags(params)
   p = params
+  # Decode-cost variants (serving/decode.py LMSpec): None-when-off so a
+  # variant-free run's spec config -- and therefore its run-store
+  # fingerprint -- is byte-identical to pre-variant history.
+  variant_kw = {}
+  if p.serving_quantize:
+    variant_kw["quantize"] = p.serving_quantize
+  if p.serving_kv_page_size:
+    variant_kw["kv_page_size"] = p.serving_kv_page_size
+  if p.serving_speculative_k:
+    variant_kw["speculative_k"] = p.serving_speculative_k
+    variant_kw["draft_n_layers"] = p.serving_draft_layers
   if on_tpu:
-    spec = LMSpec()
+    spec = LMSpec(**variant_kw)
     n_req, rate, max_new = 128, 16.0, 32
   else:
     spec = LMSpec(vocab=256, d_model=64, n_layers=2, n_heads=4,
-                  d_ff=128, max_len=128, attn_block=32)
+                  d_ff=128, max_len=128, attn_block=32, **variant_kw)
     n_req, rate, max_new = 24, 8.0, 8
   # Flag unset = the engine's own default ladder (the params.py help's
   # contract), so a default bench run fingerprints identically to any
@@ -292,6 +324,30 @@ def run_serving_bench(args, on_tpu, attempts) -> int:
       tenant_tokens_per_s=p.serving_tenant_tokens_per_s)
   n_req = args.serving_requests or n_req
   rate = args.serving_rate or rate
+  workload = poisson_workload(n_req, rate, spec, seed=0,
+                              max_new_tokens=cfg.max_new_tokens)
+
+  # INT8 accuracy gate (ISSUE 16a): before serving a quantized spec,
+  # measure prefix-conditioned greedy agreement vs the f32 weights on a
+  # probe slice of the SAME seeded workload. Below the bar the bench
+  # falls back to the dense arm and says so -- a quantized line never
+  # enters the run store without its measured accuracy evidence.
+  quantize_gate = None
+  if spec.quantize:
+    import dataclasses
+    from kf_benchmarks_tpu.serving import decode as decode_lib
+    probe = [req.prompt for _, req in workload[:8]]
+    raw = decode_lib.init_variables(spec, seed=0)
+    quantize_gate = decode_lib.quantize_agreement(
+        spec, raw, probe, max_new_tokens=min(8, cfg.max_new_tokens))
+    if not quantize_gate["passed"]:
+      print(
+          f"serving bench: int8 gate FAILED (agreement "
+          f"{quantize_gate['agreement']:.4f} < "
+          f"{decode_lib.QUANTIZE_AGREEMENT_BAR}) -- serving the dense "
+          "arm instead", file=sys.stderr, flush=True)
+      spec = dataclasses.replace(spec, quantize=None)
+      cfg = dataclasses.replace(cfg, spec=spec)
 
   trace = tracing.RunTrace(path=None)
   tracing.activate(trace)
@@ -305,8 +361,6 @@ def run_serving_bench(args, on_tpu, attempts) -> int:
   n_warm = engine.warm()  # TTFT must measure the system, not XLA
   print(f"serving bench: {n_warm} executable(s) warmed across ladder "
         f"{cfg.bucket_ladder}", file=sys.stderr, flush=True)
-  workload = poisson_workload(n_req, rate, spec, seed=0,
-                              max_new_tokens=cfg.max_new_tokens)
   engine.replay(workload)
   stats = engine.stats()
   if server is not None:
@@ -323,7 +377,21 @@ def run_serving_bench(args, on_tpu, attempts) -> int:
       "retries": attempts - 1,
       "compile_ledger": {"shapes": ledger.get("shapes", 0),
                          "total_compile_s": ledger.get("total_compile_s")},
+      # Which decode-cost variants shaped this line (ISSUE 16): the
+      # same fields ride spec.config() into the fingerprint below, so
+      # variant runs never mix with dense/bf16 history.
+      "decode_variant": {"quantize": spec.quantize,
+                         "paged_kv": spec.kv_page_size or None,
+                         "speculative_k": spec.speculative_k or None},
   }
+  if quantize_gate is not None:
+    # The measured accuracy evidence behind the int8 decision: if the
+    # gate failed, decode_variant.quantize above is already None (the
+    # served arm fell back to dense) and this block says why.
+    record["quantize_gate"] = {
+        "agreement": round(quantize_gate["agreement"], 6),
+        "max_logit_delta": round(quantize_gate["max_logit_delta"], 6),
+        "passed": quantize_gate["passed"]}
   # Every serving/* stat is a registered schema key; Nones (an empty
   # replay) drop so the JSON line stays dense.
   record.update({k: (round(v, 6) if isinstance(v, float) else v)

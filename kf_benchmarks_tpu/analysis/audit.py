@@ -465,6 +465,12 @@ def rule_serving_bounded_decode(contract, tracer):
                "update its cache in place (aliasing lost)")
   btv = contract.aux.get("vocab_logits_bytes")
   ring = contract.aux.get("kv_ring_bytes")
+  if "kv_pool_bytes" in contract.aux:
+    # Paged-KV decode: rule_serving_paged_kv owns the buffer bound for
+    # this program shape (one owner per seeded violation) -- the
+    # legitimate ceiling there is the page POOL, which must itself sit
+    # strictly under the dense ring.
+    return out
   # The ring is the largest LEGITIMATE array, so only buffers beyond
   # it are leaks; name the (B, T, V) materialization only when that
   # ceiling genuinely sits above the ring (a small-vocab spec can put
@@ -489,6 +495,66 @@ def rule_serving_bounded_decode(contract, tracer):
   return out
 
 
+def rule_serving_paged_kv(contract, tracer):
+  """Round 19: the paged-KV decode step's memory bound. Binds on
+  ``serving_decode`` contracts whose aux carries ``kv_pool_bytes`` --
+  i.e. the spec set ``kv_page_size`` and the cache is a fixed-size
+  block pool instead of the dense per-slot ring slab. Two legs: (a)
+  the pool itself must sit strictly UNDER the dense ring ceiling
+  (``kv_ring_bytes``) -- a pool that reaches the slab it replaces has
+  lost paging's whole point (that bound is what lets the engine admit
+  more concurrent sessions per HBM byte); (b) no live program buffer
+  may reach the dense-slab ceiling either -- a buffer that does is a
+  densification leak (e.g. the gather path materializing the
+  per-slot (T_max,) view for every slot at once)."""
+  if contract.program != "serving_decode":
+    return []
+  pool = contract.aux.get("kv_pool_bytes")
+  if not pool:
+    return []
+  out = []
+  ring = contract.aux.get("kv_ring_bytes")
+  if ring and pool >= ring:
+    out.append(f"paged KV pool ({pool} B) reaches the dense ring slab "
+               f"it replaces ({ring} B) -- the pool must stay strictly "
+               "under the dense ceiling or paging buys no concurrency")
+  if ring and contract.largest_tensor_bytes >= ring:
+    out.append(f"largest paged-decode buffer "
+               f"{contract.largest_tensor_type} "
+               f"({contract.largest_tensor_bytes} B) reaches the dense "
+               f"KV slab ceiling ({ring} B) -- a live buffer at the "
+               "slab size is a densification leak in the paged step")
+  return out
+
+
+def rule_serving_verify_bounded(contract, tracer):
+  """Round 19: the speculative-decoding verify step scores all k draft
+  proposals in ONE prefill-shaped call, with the logits argmax chunked
+  (lax.scan over (B, chunk, V) slices). Binds on ``serving_verify``
+  contracts: (a) the verify batch is a bucket-ladder member (same
+  bounded-executable-set invariant as decode); (b) no program buffer
+  reaches the full (B, T, V) logits tensor -- the chunked argmax
+  exists precisely so verification never materializes what the fused
+  head avoids; the (B, chunk, V) slice (``verify_logits_bytes``) is
+  the legitimate ceiling."""
+  if contract.program != "serving_verify":
+    return []
+  out = []
+  ladder = contract.aux.get("bucket_ladder") or []
+  bucket = contract.aux.get("decode_batch")
+  if ladder and bucket not in ladder:
+    out.append(f"verify batch {bucket} is not a bucket-ladder member "
+               f"{ladder} -- an off-ladder shape breaks the bounded "
+               "executable set")
+  btv = contract.aux.get("vocab_logits_bytes")
+  if btv and contract.largest_tensor_bytes >= btv:
+    out.append(f"largest verify buffer {contract.largest_tensor_type} "
+               f"({contract.largest_tensor_bytes} B) reaches the "
+               f"(B, T, V) logits tensor ({btv} B) -- the chunked "
+               "argmax must never materialize the full logits")
+  return out
+
+
 # -- program-shape invariants (every config) ----------------------------------
 
 def rule_no_host_transfer(contract, tracer):
@@ -508,6 +574,10 @@ def rule_state_donated(contract, tracer):
     # The serving step donates its KV ring, not a TrainState;
     # rule_serving_bounded_decode owns that program shape (one owner
     # per seeded violation).
+    return []
+  if contract.program == "serving_verify":
+    # The verify step is a pure function of (variables, token rows) --
+    # it owns no mutable state, so it donates nothing by design.
     return []
   if contract.donated_buffers == 0:
     return ["no input/output buffer aliasing -- the donated TrainState "
@@ -628,6 +698,8 @@ RULES: Dict[str, Callable] = {
     "fsdp-residency": rule_fsdp_residency,
     "packed-no-overhead": rule_packed_no_overhead,
     "serving-bounded-decode": rule_serving_bounded_decode,
+    "serving-paged-kv": rule_serving_paged_kv,
+    "serving-verify-bounded": rule_serving_verify_bounded,
     "no-host-transfer": rule_no_host_transfer,
     "state-donated": rule_state_donated,
     "single-optimizer-apply": rule_single_optimizer_apply,

@@ -516,19 +516,34 @@ GOLDEN_CONFIGS: "OrderedDict[str, Dict[str, Any]]" = OrderedDict([
 # (audit.rule_serving_bounded_decode).
 SERVING_GOLDEN_CONFIGS: "OrderedDict[str, Dict[str, Any]]" = OrderedDict([
     ("serving_decode", dict(bucket=4)),
+    # Decode-cost variants (ISSUE 16): each leg's program pinned at the
+    # same bucket so a variant regression (e.g. dequantize hoisted out
+    # of the step, or the paged gather collapsing back to a dense
+    # slab) diffs against ITS OWN golden, not serving_decode's.
+    ("serving_decode_int8", dict(bucket=4, quantize="int8")),
+    ("serving_decode_paged", dict(bucket=4, kv_page_size=128)),
+    # The speculative TARGET's verify program (prefill-shaped full
+    # forward + chunked argmax; program="serving_verify" routes the
+    # tracer to verify_lowering_args).
+    ("serving_verify", dict(bucket=4, speculative_k=4,
+                            draft_n_layers=2,
+                            program="serving_verify")),
 ])
 
 
 def trace_serving_contract(overrides: Dict[str, Any],
                            program: str = "serving_decode"
                            ) -> ProgramContract:
-  """Lower + compile (never execute) the serving decode step for an
-  LMSpec override dict; extract its contract.
+  """Lower + compile (never execute) a serving program for an LMSpec
+  override dict; extract its contract.
 
-  Mirrors the engine's AOT path exactly (serving/engine._decode_exe:
-  jit + donated ring buffers + lower + compile over abstract
+  Mirrors the engine's AOT path exactly (serving/engine._decode_exe /
+  _verify_exe: jit + donation + lower + compile over abstract
   ShapeDtypeStructs), so the golden pins the program the engine will
-  actually cache per bucket."""
+  actually cache per bucket. A ``program`` key in ``overrides`` routes
+  the trace (``serving_decode`` -> the decode step,
+  ``serving_verify`` -> the speculative verify forward) -- that is how
+  the golden table encodes per-program entries."""
   import dataclasses as _dc
 
   import jax
@@ -537,6 +552,7 @@ def trace_serving_contract(overrides: Dict[str, Any],
   from kf_benchmarks_tpu.serving import engine as engine_lib
 
   kw = dict(overrides)
+  program = kw.pop("program", program)
   bucket = int(kw.pop("bucket", 4))
   field_names = {f.name for f in _dc.fields(decode_lib.LMSpec)}
   unknown = sorted(set(kw) - field_names)
@@ -544,23 +560,40 @@ def trace_serving_contract(overrides: Dict[str, Any],
     raise ValueError(f"unknown LMSpec override(s) {unknown}; have "
                      f"{sorted(field_names)}")
   spec = decode_lib.LMSpec(**kw)
-  # The engine's OWN lowering recipe (decode.decode_lowering_args is
-  # the single source), so this golden pins the program the engine
-  # actually caches per bucket.
-  fn, args, donate = decode_lib.decode_lowering_args(spec, bucket)
+  # The engine's OWN lowering recipes (decode.decode_lowering_args /
+  # verify_lowering_args are the single source), so this golden pins
+  # the program the engine actually caches per bucket.
+  if program == "serving_verify":
+    fn, args, donate = decode_lib.verify_lowering_args(spec, bucket)
+  else:
+    fn, args, donate = decode_lib.decode_lowering_args(spec, bucket)
   compiled = jax.jit(fn, donate_argnums=donate).lower(*args).compile()
   itemsize = jnp.dtype(spec.dtype).itemsize
   aux: Dict[str, Any] = {
       "bucket_ladder": list(engine_lib.DEFAULT_BUCKET_LADDER),
       "decode_batch": bucket,
-      # One ring buffer's bytes (k or v; the largest LEGITIMATE array
-      # in the decode program) -- the residency bound the
+      # One DENSE ring buffer's bytes (k or v; the largest LEGITIMATE
+      # array in the dense decode program) -- the residency bound the
       # bounded-executable rule admits. Anything bigger is a leak
       # (e.g. a (B, T, V) logits buffer: vocab_logits_bytes below).
+      # For paged programs this is the ceiling the pool must stay
+      # strictly UNDER (rule serving-paged-kv).
       "kv_ring_bytes": (spec.n_layers * bucket * spec.max_len *
                         spec.n_heads * spec.head_dim * itemsize),
       "vocab_logits_bytes": bucket * spec.max_len * spec.vocab * itemsize,
   }
+  if spec.kv_page_size:
+    aux["kv_page_size"] = spec.kv_page_size
+    aux["kv_pool_bytes"] = (
+        spec.n_layers * decode_lib.kv_pool_pages(spec, bucket) *
+        spec.kv_page_size * spec.n_heads * spec.head_dim * itemsize)
+  if program == "serving_verify":
+    # The verify program's own residency bound: its chunked argmax
+    # head must keep every live logits buffer under the dense
+    # (B, T, V) tensor (rule serving-verify-bounded).
+    aux["verify_chunk"] = decode_lib.verify_chunk(spec)
+    aux["verify_logits_bytes"] = (
+        bucket * decode_lib.verify_chunk(spec) * spec.vocab * itemsize)
   temp = None
   try:
     temp = int(compiled.memory_analysis().temp_size_in_bytes)

@@ -40,7 +40,8 @@ def signature_path(path: str) -> str:
 
 
 def _write_signature(path: str, image_shape, dtype, *, quantize: bool,
-                     nclass: int, fingerprint: Optional[str]) -> None:
+                     nclass: int, fingerprint: Optional[str],
+                     kv_page_size: Optional[int] = None) -> None:
   sig = {
       "version": SIGNATURE_VERSION,
       "input_shape": [int(d) for d in image_shape],
@@ -49,6 +50,12 @@ def _write_signature(path: str, image_shape, dtype, *, quantize: bool,
       "nclass": int(nclass),
       "dtype": jnp.dtype(dtype).name,
       "quantize": bool(quantize),
+      # Round 19: the serving-mode identity a loader can diff against
+      # BEFORE deserializing -- a bf16 engine pointed at an INT8 export
+      # (or a paged engine at a dense one) fails with this sidecar
+      # diff, not a dtype/shape mismatch deep inside the XLA call.
+      "quantize_mode": "int8" if quantize else None,
+      "kv_page_size": int(kv_page_size) if kv_page_size else None,
       "fingerprint": fingerprint,
   }
   with open(signature_path(path), "w", encoding="utf-8") as f:
@@ -89,7 +96,8 @@ def sibling_batch_sizes(path: str) -> List[int]:
 def export_forward(model, variables, batch_size: int, path: str,
                    nclass: int = 1001, dtype=jnp.float32,
                    quantize: bool = False,
-                   fingerprint: Optional[str] = None) -> int:
+                   fingerprint: Optional[str] = None,
+                   kv_page_size: Optional[int] = None) -> int:
   """Serialize the frozen forward pass to ``path``; returns byte size.
 
   ``variables`` (trained params + batch stats) are captured as constants
@@ -100,7 +108,10 @@ def export_forward(model, variables, batch_size: int, path: str,
   ``fingerprint`` is the exporting run's config fingerprint
   (analysis/baseline.config_fingerprint_key), recorded in the signature
   sidecar so the artifact stays attributable to the program shape that
-  produced it.
+  produced it. ``kv_page_size`` records the exporting engine's paged-KV
+  geometry (serving/decode.py LMSpec) in the sidecar -- the exported
+  image forward has no KV cache, but a decode-family export's loader
+  must be able to diff page geometry before the XLA call.
   """
   model.set_batch_size(batch_size)
   module = model.make_module(nclass=nclass, phase_train=False,
@@ -128,12 +139,18 @@ def export_forward(model, variables, batch_size: int, path: str,
   with open(path, "wb") as f:
     f.write(data)
   _write_signature(path, image_shape, dtype, quantize=quantize,
-                   nclass=nclass, fingerprint=fingerprint)
+                   nclass=nclass, fingerprint=fingerprint,
+                   kv_page_size=kv_page_size)
   return len(data)
 
 
+_UNSET = object()
+
+
 def load_forward(path: str, expect_batch: Optional[int] = None,
-                 expect_shape: Optional[tuple] = None) -> Callable:
+                 expect_shape: Optional[tuple] = None,
+                 expect_quantize=_UNSET,
+                 expect_kv_page_size=_UNSET) -> Callable:
   """Deserialize an exported forward program into a callable.
 
   When the caller states what it is about to serve (``expect_batch`` /
@@ -141,7 +158,39 @@ def load_forward(path: str, expect_batch: Optional[int] = None,
   validated HERE, against the deserialized avals -- a mismatch names
   the exported signature, the request, and every sibling export's
   batch size (the available bucket list), instead of surfacing later
-  as an opaque XLA arity/shape error inside the call."""
+  as an opaque XLA arity/shape error inside the call.
+
+  ``expect_quantize`` (None or "int8") and ``expect_kv_page_size``
+  (None or int) state the caller's serving mode; when passed, they are
+  diffed against the signature sidecar BEFORE deserialization -- a
+  bf16 engine pointed at an INT8 export fails right here with the
+  sidecar diff, not as a dtype mismatch deep in the XLA call.
+  Pre-sidecar artifacts (no ``.sig.json``) skip the mode check and
+  stay loadable."""
+  sig = read_signature(path)
+  mode_checks = []
+  if expect_quantize is not _UNSET:
+    mode_checks.append(("quantize_mode", expect_quantize))
+  if expect_kv_page_size is not _UNSET:
+    want_page = int(expect_kv_page_size) if expect_kv_page_size else None
+    mode_checks.append(("kv_page_size", want_page))
+  if mode_checks and sig is not None:
+    def _got(key):
+      if key == "quantize_mode" and key not in sig:
+        # Pre-round-19 sidecars recorded only the quantize bool.
+        return "int8" if sig.get("quantize") else None
+      return sig.get(key)
+    diffs = [f"{key}: sidecar={_got(key)!r}, requested={want!r}"
+             for key, want in mode_checks if _got(key) != want]
+    if diffs:
+      raise ValueError(
+          f"AOT export {path} was produced for a different serving "
+          "mode -- " + "; ".join(diffs)
+          + (f" (exporting fingerprint {sig.get('fingerprint')})" if
+             sig.get("fingerprint") else "")
+          + ". Re-export with the matching mode (e.g. --trt_mode=INT8 "
+          "pairs with --serving_quantize=int8) or point the engine at "
+          "the matching artifact.")
   with open(path, "rb") as f:
     exported = jax_export.deserialize(f.read())
   avals = list(exported.in_avals)

@@ -711,10 +711,15 @@ class BenchmarkCNN:
     shape = (self.batch_size_per_device,) + self._model_image_shape()
     # Signature-validated load (aot.py): a batch/shape mismatch fails
     # HERE with the exported signature and the available bucket list,
-    # not as an XLA arity error mid-loop.
+    # not as an XLA arity error mid-loop; the serving-mode diff
+    # (quantize sidecar vs this process's --trt_mode) fails a bf16
+    # engine pointed at an INT8 export before deserialization.
+    trt_mode = (p.trt_mode or "").upper()
     serving_fn = aot.load_forward(p.aot_load_path,
                                   expect_batch=self.batch_size_per_device,
-                                  expect_shape=shape)
+                                  expect_shape=shape,
+                                  expect_quantize="int8" if
+                                  trt_mode == "INT8" else None)
     log_fn(f"Loaded frozen forward program from {p.aot_load_path}")
     images = jax.random.uniform(jax.random.PRNGKey(p.tf_random_seed or 0),
                                 shape, jnp.float32)

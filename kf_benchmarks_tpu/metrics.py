@@ -246,6 +246,26 @@ _gauge("serving/token_latency_p90", "s", "Per-token decode latency p90",
        "serving")
 _gauge("serving/token_latency_p99", "s", "Per-token decode latency p99",
        "serving")
+# Decode-cost variants (ISSUE 16): paged-KV occupancy and speculative
+# accept accounting. Variant-off engines report these as None, which
+# the publish path drops.
+_gauge("serving/kv_pages_in_use", "pages",
+       "Peak KV pool pages allocated to live requests", "serving")
+_gauge("serving/kv_page_fraction", "1",
+       "Peak allocated fraction of the KV page pool", "serving")
+_counter("serving/spec_rounds", "rounds",
+         "Speculative draft-propose/target-verify rounds", "serving")
+_counter("serving/draft_tokens", "tokens",
+         "Draft-model proposal tokens offered to the verifier",
+         "serving")
+_counter("serving/accepted_tokens", "tokens",
+         "Draft proposals accepted by the target verifier", "serving")
+_gauge("serving/accept_len_p50", "tokens",
+       "Accepted speculative prefix length p50", "serving")
+_gauge("serving/accept_len_p90", "tokens",
+       "Accepted speculative prefix length p90", "serving")
+_gauge("serving/accept_len_p99", "tokens",
+       "Accepted speculative prefix length p99", "serving")
 
 # DeviceFeeder (data/device_feed.py): run-end stats + live lanes.
 _counter("fetches", "batches", "Batches delivered to the consumer",
@@ -292,6 +312,15 @@ NON_METRIC_KEYS = frozenset({
     "state", "stopped_early", "restart_for_resize", "reshape_events",
     "aot_load_path", "value", "entries", "health",
     "latency_percentiles", "compile_ledger", "tuned_config",
+    # Round 19: the serving bench's decode-variant identity block
+    # ({quantize, paged_kv, speculative_k}) -- config provenance on the
+    # JSON line, not a measurement; the same fields fold into the
+    # record's fingerprint via the spec config.
+    "decode_variant",
+    # The int8 accuracy-gate evidence ({agreement, max_logit_delta,
+    # passed}) behind a quantized serving line -- a measured decision
+    # record, not a throughput metric.
+    "quantize_gate",
 })
 
 _PROM_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")

@@ -86,6 +86,14 @@ class _Block(nn.Module):
   decode: bool = False
   return_kv: bool = False
   decode_exact: bool = False
+  # Paged KV cache (serving/decode.py paged mode): >0 switches the
+  # decode branch's per-layer cache from a (B, T, H, Dh) ring slab to a
+  # shared (P, page, H, Dh) page POOL -- the carry additionally rides
+  # the (B, pages_per_slot) page table, writes scatter into the pool
+  # row the table maps pos's page to, and attention gathers pages
+  # (sequence.decode_attention page_table mode). 0 = the dense ring
+  # (every existing program unchanged).
+  kv_page_size: int = 0
 
   @nn.compact
   def __call__(self, carry, xs):
@@ -97,6 +105,37 @@ class _Block(nn.Module):
     ln = lambda name: nn.LayerNorm(name=name, dtype=jnp.float32,
                                    param_dtype=self.param_dtype)
     head_dim = self.d_model // self.n_heads
+    if self.decode and self.kv_page_size:
+      # Paged single-token decode: this layer's cache is the shared
+      # (P, page, H, Dh) pool; the slot's page table (carry) maps its
+      # logical page for ``pos`` to a pool row. Same submodules as the
+      # dense branch; the write is a batched scatter at (table[b,
+      # pos//page], pos%page) -- inactive/completed slots carry an
+      # all-zero table row, so their writes land on pool row 0, the
+      # engine's never-allocated scratch page (serving/engine.py).
+      x, pos, table = carry
+      ck, cv = xs
+      b = x.shape[0]
+      page = self.kv_page_size
+      t_logical = table.shape[1] * page
+      h = ln("ln1")(x).astype(self.dtype)
+      qkv = dense(3 * self.d_model, "qkv", bias=False)(h)
+      qkv = qkv.reshape(b, 1, 3, self.n_heads, head_dim)
+      rpos = pos % t_logical
+      pg = jnp.take_along_axis(table, (rpos // page)[:, None],
+                               axis=1)[:, 0]                   # (B,)
+      ck = ck.at[pg, rpos % page].set(qkv[:, 0, 1])
+      cv = cv.at[pg, rpos % page].set(qkv[:, 0, 2])
+      att = sequence_lib.decode_attention(
+          qkv[:, :, 0], ck, cv, pos, block=page,
+          impl=self.attn_impl, exact=self.decode_exact,
+          q_block=page, page_table=table)
+      x = x + dense(self.d_model, "attn_out")(
+          att.reshape(b, 1, self.d_model))
+      h = ln("ln2")(x).astype(self.dtype)
+      h = nn.gelu(dense(self.d_ff, "mlp_up")(h))
+      x = x + dense(self.d_model, "mlp_down")(h)
+      return (x, pos, table), (ck, cv)
     if self.decode:
       # Single-token decode over the KV ring buffer. Same submodule
       # names as the forward branch, so trained/initialized variables
@@ -227,11 +266,19 @@ class _TransformerLMModule(nn.Module):
   decode: bool = False
   return_kv: bool = False
   decode_exact: bool = False
+  # Paged KV decode (serving/decode.py paged mode): >0 makes the decode
+  # path take (L, P, page, H, Dh) page POOLS plus a (B, pages_per_slot)
+  # page table instead of the dense per-slot ring slab (the _Block
+  # field of the same name). 0 = dense ring; the forward/training
+  # program never sees it.
+  kv_page_size: int = 0
 
   @nn.compact
-  def __call__(self, tokens, cache_k=None, cache_v=None, pos=None):
+  def __call__(self, tokens, cache_k=None, cache_v=None, pos=None,
+               page_table=None):
     if self.decode:
-      return self._decode_call(tokens, cache_k, cache_v, pos)
+      return self._decode_call(tokens, cache_k, cache_v, pos,
+                               page_table)
     tokens = tokens.astype(jnp.int32)
     seg = positions = None
     if tokens.ndim == 3:
@@ -336,7 +383,7 @@ class _TransformerLMModule(nn.Module):
       return out, aux, kv
     return out, aux
 
-  def _decode_call(self, tokens, cache_k, cache_v, pos):
+  def _decode_call(self, tokens, cache_k, cache_v, pos, page_table=None):
     """The single-token KV-ring decode step (serving/decode.py).
 
     ``tokens`` (B,) int32 is each slot's CURRENT token at absolute
@@ -348,6 +395,12 @@ class _TransformerLMModule(nn.Module):
     wraps and attention covers the trailing ``max_len``-token window.
     Always the dense head -- a (B, 1, V) logits row is microscopic
     next to the fused head's reason for existing.
+
+    With ``kv_page_size`` set, ``cache_k``/``cache_v`` are the shared
+    (L, P, page, H, Dh) page pools and ``page_table`` the per-slot
+    (B, pages_per_slot) pool-row map; the table rides the scan carry
+    (shared by every layer) while the pools stay the scanned
+    input/output, so the layer structure is the dense branch's.
     """
     tok = tokens.astype(jnp.int32).reshape(-1, 1)
     b = tok.shape[0]
@@ -356,7 +409,8 @@ class _TransformerLMModule(nn.Module):
         attn_block=self.attn_block, attn_q_block=self.attn_q_block,
         attn_impl=self.attn_impl, dtype=self.dtype,
         param_dtype=self.param_dtype, decode=True,
-        decode_exact=self.decode_exact)
+        decode_exact=self.decode_exact,
+        kv_page_size=self.kv_page_size)
     x = nn.Embed(self.vocab, self.d_model, name="embed",
                  dtype=self.dtype, param_dtype=self.param_dtype)(tok)
     pos_emb = self.param(
@@ -367,20 +421,27 @@ class _TransformerLMModule(nn.Module):
     # row the full forward adds at that position.
     x = x + jnp.take(pos_emb, pos % self.max_len,
                      axis=0)[:, None, :].astype(self.dtype)
+    if self.kv_page_size:
+      carry_in = (x, pos, page_table.astype(jnp.int32))
+    else:
+      carry_in = (x, pos)
     if self.scan_layers:
       blocks = nn.scan(
           _Block,
           variable_axes={"params": 0},
           split_rngs={"params": True},
           length=self.n_layers)(name="blocks", **block_kwargs)
-      (x, _), (ck, cv) = blocks((x, pos), (cache_k, cache_v))
+      carry_out, (ck, cv) = blocks(carry_in, (cache_k, cache_v))
+      x = carry_out[0]
     else:
       cks, cvs = [], []
+      carry = carry_in
       for i in range(self.n_layers):
-        (x, _), (ck_i, cv_i) = _Block(name=f"block_{i}", **block_kwargs)(
-            (x, pos), (cache_k[i], cache_v[i]))
+        carry, (ck_i, cv_i) = _Block(name=f"block_{i}", **block_kwargs)(
+            carry, (cache_k[i], cache_v[i]))
         cks.append(ck_i)
         cvs.append(cv_i)
+      x = carry[0]
       ck, cv = jnp.stack(cks), jnp.stack(cvs)
     x = nn.LayerNorm(name="ln_f", dtype=jnp.float32,
                      param_dtype=self.param_dtype)(x)

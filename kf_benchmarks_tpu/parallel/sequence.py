@@ -554,7 +554,8 @@ def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
 def decode_attention(q, k, v, pos, block: Optional[int] = None,
                      impl: str = "tiled", scale: Optional[float] = None,
                      cpu_fallback: Optional[bool] = None,
-                     exact: bool = False, q_block: Optional[int] = None):
+                     exact: bool = False, q_block: Optional[int] = None,
+                     page_table=None):
   """Single-query attention over a KV ring buffer -- the serving decode
   step's core (serving/decode.py threads the cache through it).
 
@@ -584,12 +585,60 @@ def decode_attention(q, k, v, pos, block: Optional[int] = None,
   instead; XLA schedules the (1, T) contraction differently from the
   (T, T) one, so it agrees to float rounding (~1e-6 rel), not bitwise
   -- ~T x cheaper, the production serving path.
+
+  ``page_table`` switches on the PAGED KV layout (the vLLM block-table
+  idea on JAX gather indices; serving/decode.py paged caches): ``k``/
+  ``v`` are then fixed-size page POOLS (P, page, H, D) shared across
+  slots, and ``page_table`` (B, pages_per_slot) int32 maps each slot's
+  logical page ``j`` to a pool row. The fast path is the SAME
+  ``_block_update`` online-softmax scan as the dense tiled schedule
+  with the reshape-slice replaced by a pool gather and the block size
+  pinned to the page size -- per-block inputs are value-identical to a
+  dense ring holding the same tokens, which is the paged/dense
+  bit-identity contract tests/test_serving_variants.py pins at gemm
+  shapes. Entries of unallocated table slots point at pool row 0 (the
+  never-allocated scratch page); the position mask makes them
+  contribute exactly zero, same as stale dense ring rows. Paged fast
+  mode always runs the tiled gather schedule (the Pallas flash kernel
+  has no block-table mode here); ``exact=True`` gathers the dense
+  (B, T, H, D) view back out of the pool first and runs the dense
+  oracle on it -- oracle/test mode only, since materializing the dense
+  slab is exactly what paging exists to avoid.
   """
   b, tq, h, d = q.shape
-  t = k.shape[1]
   scale = (1.0 / math.sqrt(d)) if scale is None else scale
   if impl not in ("tiled", "flash"):
     raise ValueError(f"impl must be 'tiled' or 'flash', got {impl!r}")
+  if page_table is not None:
+    page = k.shape[1]
+    pages_per_slot = page_table.shape[1]
+    if exact:
+      # Dense-view reconstruction: pool rows gathered back into each
+      # slot's (T, page) layout. k[page_table] is (B, pps, page, H, D).
+      kd = k[page_table].reshape(b, pages_per_slot * page, k.shape[2],
+                                 k.shape[3])
+      vd = v[page_table].reshape(b, pages_per_slot * page, v.shape[2],
+                                 v.shape[3])
+      return decode_attention(q, kd, vd, pos, block=block, impl=impl,
+                              scale=scale, cpu_fallback=cpu_fallback,
+                              exact=True, q_block=q_block)
+    m0 = jnp.full((b, k.shape[2], tq), _NEG, jnp.float32)
+    l0 = jnp.zeros((b, k.shape[2], tq), jnp.float32)
+    o0 = jnp.zeros((b, tq, k.shape[2], d), jnp.float32)
+
+    def page_step(carry, j):
+      ids = lax.dynamic_index_in_dim(page_table, j, axis=1,
+                                     keepdims=False)       # (B,)
+      kj, vj = k[ids], v[ids]                    # (B, page, H, D)
+      mask = (pos[:, None, None, None] >=
+              (j * page + jnp.arange(page))[None, None, None, :])
+      return _block_update(q, kj, vj, *carry, scale, mask), None
+
+    (m, l, o), _ = lax.scan(page_step, (m0, l0, o0),
+                            jnp.arange(pages_per_slot))
+    out = o / jnp.maximum(l, 1e-30).swapaxes(1, 2)[..., None]
+    return out.astype(q.dtype)
+  t = k.shape[1]
   if exact:
     # Scatter row clamped to the LAST ring row once pos wraps past the
     # buffer: the causal mask at row t-1 admits every slot, which is

@@ -560,6 +560,35 @@ flags.DEFINE_float("serving_tenant_tokens_per_s", None,
                    "an over-budget request is REJECTED with the "
                    "tenant_budget shed reason. None = unmetered.",
                    lower_bound=0.0)
+# Decode-cost variants (ISSUE 16). All default None/off so a
+# variant-off run's config fingerprint is byte-identical to before.
+flags.DEFINE_enum("serving_quantize", None, ("int8",),
+                  "Weight-only quantization of the served model: "
+                  "'int8' stores per-out-channel {int8, f32 scale} "
+                  "leaves (quantization.py) dequantized INSIDE the "
+                  "compiled step -- the TPU-native analog of the "
+                  "reference's --trt_mode=INT8 (ref :615-620). None "
+                  "= bf16/f32 weights.")
+flags.DEFINE_integer("serving_kv_page_size", None,
+                     "Paged KV cache: replace the dense per-slot "
+                     "(T_max) ring slab with a shared fixed-size "
+                     "block pool + per-request page tables at this "
+                     "page size (tokens/page; must divide the "
+                     "context length -- validation.py). None = the "
+                     "dense ring slab.", lower_bound=1)
+flags.DEFINE_integer("serving_speculative_k", None,
+                     "Speculative decoding: a shallow draft proposes "
+                     "k tokens per target dispatch; the target "
+                     "verifies all k in ONE prefill-shaped call "
+                     "(greedy output stays token-identical to plain "
+                     "greedy). Requires --serving_draft_layers "
+                     "(validation.py).", lower_bound=2)
+flags.DEFINE_integer("serving_draft_layers", None,
+                     "Depth of the speculative draft model (same "
+                     "transformer_lm family; must be < the served "
+                     "model's layer count). Only meaningful with "
+                     "--serving_speculative_k (validation.py).",
+                     lower_bound=1)
 # Distributed / cluster flags (ref :570-583).
 flags.DEFINE_enum("job_name", "", ("ps", "worker", "controller", ""),
                   "Job role for multi-process runs (ref :571-573).")

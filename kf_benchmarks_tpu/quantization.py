@@ -107,6 +107,15 @@ def dequantize_variables(qvars, dtype=jnp.float32):
   return jax.tree.map(dequant, qvars, is_leaf=_is_qleaf)
 
 
+def has_quantized_leaves(tree) -> bool:
+  """True if any leaf is a {q, scale} quantized dict -- the
+  idempotence check for serving's prepare_variables (a tree quantized
+  once must not be re-quantized: int8 leaves under quantize would be
+  treated as tiny float kernels and corrupt the scales)."""
+  return any(_is_qleaf(leaf)
+             for leaf in jax.tree.leaves(tree, is_leaf=_is_qleaf))
+
+
 def quantized_fraction(qvars) -> float:
   """Fraction of parameter ELEMENTS stored as int8 -- a sanity metric
   for logs/tests (a model whose kernels all fell under the size

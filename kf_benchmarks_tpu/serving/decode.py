@@ -35,13 +35,32 @@ production default.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, NamedTuple, Tuple
+import math
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
+from kf_benchmarks_tpu import quantization
 from kf_benchmarks_tpu.models import transformer_lm as lm
+
+# Paged-KV pool sizing: the pool provisions this fraction of the dense
+# slab's pages (bucket x pages_per_slot) plus the scratch page --
+# HALF the dense ceiling, because the workload's lognormal prompt
+# lengths (data/packing.py) put typical occupancy far below worst-case
+# T_max, which is the whole point of paging: the budget scales with
+# actual tokens. Floored at one full sequence + scratch so a
+# max-length request always fits an empty pool.
+KV_POOL_FRACTION = 0.5
+
+# The target verifier computes greedy argmax CHUNK-wise over the
+# sequence (max_len/8 positions of logits at a time, gcd-clamped so the
+# chunk divides max_len) -- the fused-head discipline applied to
+# verification: no (B, T, V) logits tensor ever exists in the verify
+# program (audit rule serving-verify-bounded).
+VERIFY_CHUNK_DENOM = 8
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,10 +82,55 @@ class LMSpec:
   decode_exact: bool = False
   dtype: Any = jnp.float32
   param_dtype: Any = jnp.float32
+  # --- decode-cost variants (ISSUE 16); all default-off, and all emit
+  # None into config() when off so config_fingerprint_key drops them
+  # and pre-variant fingerprints/goldens stay byte-identical. ---
+  # "int8": weight-only per-out-channel INT8 (quantization.py leaves),
+  # dequantized INSIDE the compiled step -- the TPU-native analog of
+  # the reference's --trt_mode=INT8 (benchmark_cnn.py:453-460).
+  quantize: Optional[str] = None
+  # >0: paged KV -- (L, P, page, H, Dh) block pool + per-request page
+  # tables instead of the dense (L, B, T_max, H, Dh) ring slab.
+  kv_page_size: int = 0
+  # >0: speculative decoding -- a draft_n_layers-deep draft proposes
+  # k tokens per target verify dispatch.
+  speculative_k: int = 0
+  draft_n_layers: int = 0
+
+  def __post_init__(self):
+    if self.quantize not in (None, "int8"):
+      raise ValueError(
+          f"quantize must be None or 'int8', got {self.quantize!r}")
+    if self.kv_page_size < 0 or (
+        self.kv_page_size and self.max_len % self.kv_page_size):
+      raise ValueError(
+          f"kv_page_size ({self.kv_page_size}) must be positive and "
+          f"divide max_len ({self.max_len}): partial pages would break "
+          "the page-table <-> ring position bijection")
+    if self.speculative_k < 0 or self.draft_n_layers < 0:
+      raise ValueError("speculative_k/draft_n_layers must be >= 0")
+    if self.speculative_k == 1:
+      raise ValueError(
+          "speculative_k must be >= 2: one proposal per target verify "
+          "is strictly slower than plain decode (a verify dispatch "
+          "costs a full forward)")
+    if self.speculative_k and not (
+        0 < self.draft_n_layers < self.n_layers):
+      raise ValueError(
+          f"speculative_k={self.speculative_k} requires a draft spec: "
+          f"0 < draft_n_layers ({self.draft_n_layers}) < n_layers "
+          f"({self.n_layers})")
+    if self.draft_n_layers and not self.speculative_k:
+      raise ValueError(
+          "draft_n_layers without speculative_k is inert -- set both")
 
   @property
   def head_dim(self) -> int:
     return self.d_model // self.n_heads
+
+  @property
+  def pages_per_slot(self) -> int:
+    return self.max_len // self.kv_page_size if self.kv_page_size else 0
 
   def config(self) -> dict:
     """The fingerprint payload (analysis/baseline.config_fingerprint_key
@@ -80,14 +144,25 @@ class LMSpec:
         "decode_exact": self.decode_exact,
         "dtype": jnp.dtype(self.dtype).name,
         "param_dtype": jnp.dtype(self.param_dtype).name,
+        # None-when-disabled: fingerprints drop None fields, so
+        # variant-off configs hash exactly as before this round.
+        "quantize": self.quantize,
+        "kv_page_size": self.kv_page_size or None,
+        "speculative_k": self.speculative_k or None,
+        "draft_n_layers": self.draft_n_layers or None,
     }
 
 
 class CacheState(NamedTuple):
   """The explicit ring-buffer decode state. ``k``/``v``:
-  (L, B, T, H, Dh); ``pos``: (B,) absolute position of each slot's
-  CURRENT token; ``tok``: (B,) the token at that position (not yet in
-  the cache -- the next decode step writes it)."""
+  (L, B, T, H, Dh) dense, or the shared (L, P, page, H, Dh) block POOL
+  when ``spec.kv_page_size`` is set (pool row 0 is the scratch page --
+  never allocated, it absorbs unallocated page-table entries); ``pos``:
+  (B,) absolute position of each slot's CURRENT token; ``tok``: (B,)
+  the token at that position (not yet in the cache -- the next decode
+  step writes it). In paged mode the per-slot page tables are HOST
+  state (engine._table_np), passed to each step as a (B, pages_per_
+  slot) int32 arg -- they are scheduler metadata, not model state."""
   k: Any
   v: Any
   pos: Any
@@ -112,10 +187,48 @@ def forward_module(spec: LMSpec, fused_head: bool = True,
 
 
 def decode_module(spec: LMSpec):
-  """The single-token KV-ring decode module."""
+  """The single-token KV-ring (or paged-pool) decode module."""
   return lm._TransformerLMModule(fused_head=False, decode=True,
                                  decode_exact=spec.decode_exact,
+                                 kv_page_size=spec.kv_page_size,
                                  **_module_kwargs(spec))
+
+
+def draft_spec(spec: LMSpec) -> LMSpec:
+  """The speculative draft model's spec: the SAME transformer_lm family
+  truncated to ``draft_n_layers`` (identical per-layer params tree
+  shape, so a distilled draft checkpoint drops in). Quantize and
+  kv_page_size carry over -- the three decode-cost legs compose: the
+  engine's step loop (and therefore its caches and compiled decode
+  programs) runs the DRAFT when speculative_k is set."""
+  if not spec.speculative_k:
+    raise ValueError("draft_spec needs speculative_k > 0")
+  return dataclasses.replace(spec, n_layers=spec.draft_n_layers,
+                             speculative_k=0, draft_n_layers=0)
+
+
+def truncate_variables(spec: LMSpec, variables):
+  """Derive draft weights from the TARGET's by layer truncation: the
+  draft keeps the embedding, positional table, final LN and head, plus
+  the first ``draft_n_layers`` entries of the scanned block stack --
+  the zero-training baseline draft (a distilled checkpoint of the same
+  shape drops in wherever this is used). Float trees only; quantize
+  AFTER truncation so the draft gets its own per-channel scales."""
+  if not spec.speculative_k:
+    raise ValueError("truncate_variables needs speculative_k > 0")
+  if not spec.scan_layers:
+    raise ValueError(
+        "truncate_variables slices the scanned block stack; "
+        "scan_layers=False lays blocks out as separate modules")
+  if quantization.has_quantized_leaves(variables):
+    raise ValueError("truncate a float tree, then prepare_variables")
+  d = spec.draft_n_layers
+  params = variables["params"]
+  blocks = jax.tree.map(lambda x: x[:d], params["blocks"])
+  new_params = {k: (blocks if k == "blocks" else v)
+                for k, v in params.items()}
+  return {k: (new_params if k == "params" else v)
+          for k, v in variables.items()}
 
 
 def init_variables(spec: LMSpec, seed: int = 0):
@@ -129,17 +242,64 @@ def init_variables(spec: LMSpec, seed: int = 0):
 
 def abstract_variables(spec: LMSpec):
   """ShapeDtypeStruct variable tree (nothing executes) -- the AOT
-  lowering input and the auditor's tracing input."""
+  lowering input and the auditor's tracing input. With
+  ``spec.quantize`` the abstract tree is the QUANTIZED one ({int8 q,
+  f32 per-channel scale} dict leaves on the large kernels), matching
+  what the engine actually feeds the compiled programs."""
   module = forward_module(spec, fused_head=True)
   sample = jnp.zeros((1, spec.max_len), jnp.int32)
-  return jax.eval_shape(
-      lambda: module.init({"params": jax.random.PRNGKey(0),
-                           "dropout": jax.random.PRNGKey(0)}, sample))
+
+  def build():
+    variables = module.init({"params": jax.random.PRNGKey(0),
+                             "dropout": jax.random.PRNGKey(0)}, sample)
+    if spec.quantize == "int8":
+      variables = quantization.quantize_variables(variables)
+    return variables
+
+  return jax.eval_shape(build)
+
+
+def prepare_variables(spec: LMSpec, variables):
+  """Bring a float param tree into the form the spec's compiled
+  programs expect: per-channel INT8 leaves when ``spec.quantize``.
+  Idempotent -- an already-quantized tree passes through."""
+  if spec.quantize == "int8" and not quantization.has_quantized_leaves(
+      variables):
+    variables = quantization.quantize_variables(variables)
+  return variables
+
+
+def _serving_view(spec: LMSpec, variables):
+  """Inside-the-step weight view: dequantize INT8 leaves back to
+  ``param_dtype`` so all matmuls see a plain float tree. Traced into
+  the compiled step -- the executable's weight inputs stay int8, which
+  is the whole HBM-traffic point (~4x fewer weight bytes per
+  weight-bound single-token matmul)."""
+  if spec.quantize == "int8":
+    return quantization.dequantize_variables(variables,
+                                             spec.param_dtype)
+  return variables
+
+
+def kv_pool_pages(spec: LMSpec, bucket: int) -> int:
+  """Pool size P for a paged cache at this bucket: scratch page 0 plus
+  KV_POOL_FRACTION of the dense slab's page count, floored at one full
+  sequence -- strictly below the dense ceiling for every bucket > 1,
+  which is the auditor's serving-paged-kv bound."""
+  pps = spec.pages_per_slot
+  return max(pps + 1, 1 + math.ceil(bucket * pps * KV_POOL_FRACTION))
+
+
+def _cache_shape(spec: LMSpec, bucket: int):
+  if spec.kv_page_size:
+    return (spec.n_layers, kv_pool_pages(spec, bucket),
+            spec.kv_page_size, spec.n_heads, spec.head_dim)
+  return (spec.n_layers, bucket, spec.max_len, spec.n_heads,
+          spec.head_dim)
 
 
 def init_cache(spec: LMSpec, bucket: int) -> CacheState:
-  shape = (spec.n_layers, bucket, spec.max_len, spec.n_heads,
-           spec.head_dim)
+  shape = _cache_shape(spec, bucket)
   return CacheState(
       k=jnp.zeros(shape, spec.dtype), v=jnp.zeros(shape, spec.dtype),
       pos=jnp.zeros((bucket,), jnp.int32),
@@ -149,20 +309,21 @@ def init_cache(spec: LMSpec, bucket: int) -> CacheState:
 def grow_cache(cache: CacheState, spec: LMSpec,
                bucket: int) -> CacheState:
   """Migrate a cache onto a wider bucket (ladder growth): old slots
-  keep their contents and positions, new slots start empty."""
+  keep their contents and positions, new slots start empty. Paged
+  mode copies the pool prefix, so every already-allocated page index
+  stays valid in the wider pool."""
   fresh = init_cache(spec, bucket)
   old = cache.k.shape[1]
   return CacheState(
       k=fresh.k.at[:, :old].set(cache.k),
       v=fresh.v.at[:, :old].set(cache.v),
-      pos=fresh.pos.at[:old].set(cache.pos),
-      tok=fresh.tok.at[:old].set(cache.tok))
+      pos=fresh.pos.at[:cache.pos.shape[0]].set(cache.pos),
+      tok=fresh.tok.at[:cache.tok.shape[0]].set(cache.tok))
 
 
 def abstract_cache(spec: LMSpec, bucket: int) -> CacheState:
   """ShapeDtypeStruct cache (no allocation) -- AOT lowering input."""
-  shape = (spec.n_layers, bucket, spec.max_len, spec.n_heads,
-           spec.head_dim)
+  shape = _cache_shape(spec, bucket)
   return CacheState(
       k=jax.ShapeDtypeStruct(shape, spec.dtype),
       v=jax.ShapeDtypeStruct(shape, spec.dtype),
@@ -178,20 +339,43 @@ def decode_lowering_args(spec: LMSpec, bucket: int):
   golden can never silently pin a program the engine no longer
   compiles."""
   cache = abstract_cache(spec, bucket)
-  args = (abstract_variables(spec), cache.k, cache.v, cache.pos,
-          cache.tok, jax.ShapeDtypeStruct((bucket,), jnp.bool_))
+  if spec.kv_page_size:
+    args = (abstract_variables(spec), cache.k, cache.v, cache.pos,
+            cache.tok,
+            jax.ShapeDtypeStruct((bucket, spec.pages_per_slot),
+                                 jnp.int32),
+            jax.ShapeDtypeStruct((bucket,), jnp.bool_))
+  else:
+    args = (abstract_variables(spec), cache.k, cache.v, cache.pos,
+            cache.tok, jax.ShapeDtypeStruct((bucket,), jnp.bool_))
   return decode_fn(spec), args, (1, 2)
 
 
 def decode_fn(spec: LMSpec):
-  """``(variables, k, v, pos, tok, active) -> (next_tok, k', v',
-  pos')`` -- one greedy decode step for every slot; inactive slots
-  hold their token and position (their ring writes land on a slot the
-  next prefill re-installs wholesale). The engine compiles this per
-  bucket with the caches donated."""
+  """``(variables, k, v, pos, tok[, page_table], active) -> (next_tok,
+  k', v', pos')`` -- one greedy decode step for every slot; inactive
+  slots hold their token and position (their ring writes land on a
+  slot the next prefill re-installs wholesale; in paged mode inactive
+  slots' tables point at the scratch page, so their writes land
+  nowhere live). The engine compiles this per bucket with the caches
+  donated."""
   module = decode_module(spec)
 
+  if spec.kv_page_size:
+    def paged_step(variables, cache_k, cache_v, pos, tok, page_table,
+                   active):
+      variables = _serving_view(spec, variables)
+      logits, (cache_k, cache_v) = module.apply(
+          variables, tok, cache_k, cache_v, pos, page_table)
+      nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+      nxt = jnp.where(active, nxt, tok)
+      pos = pos + active.astype(jnp.int32)
+      return nxt, cache_k, cache_v, pos
+
+    return paged_step
+
   def step(variables, cache_k, cache_v, pos, tok, active):
+    variables = _serving_view(spec, variables)
     logits, (cache_k, cache_v) = module.apply(variables, tok, cache_k,
                                               cache_v, pos)
     nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
@@ -228,6 +412,7 @@ def prefill_fn(spec: LMSpec):
   t_cache = spec.max_len
 
   def prefill(variables, packed, rows, last_pos, offsets):
+    variables = _serving_view(spec, variables)
     head, _aux, (kst, vst) = module.apply(variables, packed)
     # First sampled token per request: the dense head's row, computed
     # only at the prompts' final positions (bit-identical to the
@@ -265,6 +450,89 @@ def install_prefill(cache: CacheState, ek, ev, first, lengths,
       tok=cache.tok.at[slots].set(first, mode="drop"))
 
 
+def install_prefill_paged(cache: CacheState, ek, ev, first, lengths,
+                          slots, req_tables) -> CacheState:
+  """Paged-mode prefill install: chop each request's (L, T, H, Dh)
+  span into pages_per_slot (L, page, H, Dh) pages and scatter them
+  into the pool rows ``req_tables`` names. ``req_tables`` is
+  (B_pack, pages_per_slot) int32 holding allocated pool-row ids in
+  LOGICAL page order, with an out-of-range sentinel (>= P) on
+  unallocated pages and padding rows -- ``mode="drop"`` discards
+  those, so only allocated pages are written (the dense install's
+  stale-inclusive discipline, page-granular)."""
+  l_, page = cache.k.shape[0], cache.k.shape[2]
+  bpk, _, t, h_, dh = ek.shape
+  pps = t // page
+  ids = jnp.asarray(req_tables, jnp.int32).reshape(-1)  # (B_pack*pps,)
+
+  def paginate(arr):
+    # (B_pack, L, T, H, Dh) -> (L, B_pack*pps, page, H, Dh), b-major
+    # page-minor to match ids' row-major flattening.
+    pag = arr.reshape(bpk, l_, pps, page, h_, dh)
+    return jnp.moveaxis(pag, 1, 0).reshape(l_, bpk * pps, page, h_, dh)
+
+  return CacheState(
+      k=cache.k.at[:, ids].set(paginate(ek), mode="drop"),
+      v=cache.v.at[:, ids].set(paginate(ev), mode="drop"),
+      pos=cache.pos.at[slots].set(lengths, mode="drop"),
+      tok=cache.tok.at[slots].set(first, mode="drop"))
+
+
+def verify_chunk(spec: LMSpec) -> int:
+  """Sequence-chunk width for the verify program's argmax head
+  (gcd-clamped so it divides max_len exactly)."""
+  return math.gcd(spec.max_len,
+                  max(1, spec.max_len // VERIFY_CHUNK_DENOM))
+
+
+def verify_fn(spec: LMSpec):
+  """``(variables, tokens) -> preds`` -- the speculative TARGET
+  verifier: ONE prefill-shaped full forward over (B, max_len) token
+  rows, returning the greedy argmax at EVERY position --
+  ``preds[b, t]`` is the target's greedy choice for position t+1 given
+  ``tokens[b, :t+1]``. The engine lays each slot's confirmed history
+  ++ draft proposals into a row, runs this once, and accepts the
+  longest agreeing prefix -- so k proposals cost one target dispatch
+  instead of k, and greedy output is token-identical to plain greedy
+  by construction (causality: preds at position t never sees tokens
+  past t, so an accepted prefix's predictions match what sequential
+  greedy decode would have produced).
+
+  The fused head keeps this logits-free in the large: hidden states
+  are chunked along T (verify_chunk positions at a time) through a
+  ``lax.scan``, so the biggest live logits buffer is
+  (B, chunk, V) << the (B, T, V) dense-head tensor -- the
+  serving-verify-bounded audit rule pins that."""
+  module = forward_module(spec, fused_head=True)
+  chunk = verify_chunk(spec)
+
+  def verify(variables, tokens):
+    variables = _serving_view(spec, variables)
+    head, _aux = module.apply(variables, tokens)
+    kernel = head.kernel.astype(spec.dtype)
+    b, t, dm = head.hidden.shape
+    hc = head.hidden.reshape(b, t // chunk, chunk, dm)
+    hc = jnp.swapaxes(hc, 0, 1)                  # (n_chunks, B, c, D)
+
+    def step(carry, h):
+      logits = h.astype(spec.dtype) @ kernel     # (B, chunk, V)
+      return carry, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    _, preds = lax.scan(step, None, hc)          # (n_chunks, B, c)
+    return jnp.swapaxes(preds, 0, 1).reshape(b, t)
+
+  return verify
+
+
+def verify_lowering_args(spec: LMSpec, bucket: int):
+  """AOT lowering recipe for the verify program (program family
+  ``serving_verify``): no donation -- its only inputs are the frozen
+  weights and the (B, max_len) token rows."""
+  args = (abstract_variables(spec),
+          jax.ShapeDtypeStruct((bucket, spec.max_len), jnp.int32))
+  return verify_fn(spec), args, ()
+
+
 def reference_generate(spec: LMSpec, variables, prompt,
                        max_new_tokens: int) -> Tuple[Any, Any]:
   """Greedy generation straight through the full-sequence forward --
@@ -286,3 +554,69 @@ def reference_generate(spec: LMSpec, variables, prompt,
     out.append(nxt)
     toks.append(nxt)
   return (out[0] if out else None), out
+
+
+# The INT8 accuracy gate (ISSUE 16): minimum prefix-conditioned greedy
+# agreement for quantized serving to be admitted. The metric is
+# NEXT-TOKEN agreement given the f32 arm's confirmed prefix (the
+# speculative-decoding acceptance metric), not whole-sequence zip --
+# zip charges every post-flip token to the first flip (greedy decode
+# compounds), which says nothing about per-step accuracy.
+QUANTIZE_AGREEMENT_BAR = 0.99
+
+
+def quantize_agreement(spec: LMSpec, variables, prompts,
+                       max_new_tokens: int) -> Dict[str, Any]:
+  """Measure the INT8 accuracy delta on a seeded probe: generate the
+  f32 arm's greedy rows (batched, teacher-forced through verify_fn's
+  full forward), then score the QUANTIZED model's greedy choice at
+  every generated position against them, plus the max logit delta.
+
+  ``spec`` must set ``quantize``; ``variables`` is the float tree.
+  Returns {agreement, total, max_logit_delta, logit_scale, passed} --
+  the caller gates quantized serving on ``passed`` (the bar is
+  QUANTIZE_AGREEMENT_BAR). Random-init weights are the adversarial
+  case (argmax margins are razor-thin, so per-mille logit noise flips
+  tokens); a gate that admits them would admit anything."""
+  if not spec.quantize:
+    raise ValueError("quantize_agreement needs a quantized spec")
+  fspec = dataclasses.replace(spec, quantize=None)
+  fvf = jax.jit(verify_fn(fspec))
+  qvf = jax.jit(verify_fn(spec))
+  qvars = prepare_variables(spec, variables)
+  n = len(prompts)
+  rows = np.zeros((n, spec.max_len), np.int32)
+  lens = []
+  for i, prompt in enumerate(prompts):
+    p = np.asarray(prompt, np.int32).reshape(-1)
+    p = p[:max(1, spec.max_len - max_new_tokens)]
+    rows[i, :p.size] = p
+    lens.append(p.size)
+  q0 = list(lens)
+  for _ in range(max_new_tokens):
+    preds = np.asarray(fvf(variables, jnp.asarray(rows)))
+    for i in range(n):
+      if lens[i] < spec.max_len:
+        rows[i, lens[i]] = preds[i, lens[i] - 1]
+        lens[i] += 1
+  qpreds = np.asarray(qvf(qvars, jnp.asarray(rows)))
+  total = agree = 0
+  for i in range(n):
+    for t in range(q0[i], lens[i]):
+      total += 1
+      agree += int(qpreds[i, t - 1] == rows[i, t])
+  agreement = agree / max(total, 1)
+  # Max logit delta over a bounded slice of the probe rows (the
+  # whole-probe forward would be a (N, T, V) pair of tensors).
+  module = forward_module(fspec, fused_head=False)
+  apply = jax.jit(module.apply)
+  probe = jnp.asarray(rows[:min(n, 4)])
+  ref, _ = apply(variables, probe)
+  got, _ = apply(quantization.dequantize_variables(qvars,
+                                                   spec.param_dtype),
+                 probe)
+  delta = float(jnp.max(jnp.abs(got - ref)))
+  scale = float(jnp.max(jnp.abs(ref)))
+  return {"agreement": agreement, "total": total,
+          "max_logit_delta": delta, "logit_scale": scale,
+          "passed": agreement >= QUANTIZE_AGREEMENT_BAR}

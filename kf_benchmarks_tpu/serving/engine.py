@@ -21,6 +21,17 @@ turns request ARRIVALS into device throughput:
   TTFT-deadline expiry at coalesce time, and a per-tenant token-bucket
   budget; rejected/expired requests are first-class results and
   ``serving/*`` metrics, never exceptions.
+* **Decode-cost variants** (ISSUE 16; composable, all spec-driven) --
+  ``spec.quantize='int8'`` serves per-channel INT8 weights dequantized
+  inside the compiled step; ``spec.kv_page_size`` runs the paged KV
+  pool with this engine as the page ALLOCATOR (pages granted for a
+  request's whole lifetime at prefill, freed at completion, pool
+  exhaustion requeues the wave remainder -- a shed path, never an
+  exception); ``spec.speculative_k`` runs draft-propose/target-verify
+  rounds where the engine's step loop drives the DRAFT model and the
+  target is consulted once per round through a prefill-shaped verify
+  program (greedy output stays token-identical to plain greedy decode
+  -- every emitted token is the target verifier's own argmax).
 * **Observability joins** -- request spans (enqueue -> coalesce ->
   prefill -> decode -> done) land on the active ``RunTrace`` timeline
   ("serving" lane); TTFT / per-token latency ride ``add_sample`` into
@@ -41,6 +52,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from kf_benchmarks_tpu import metrics as metrics_lib
+from kf_benchmarks_tpu import quantization
 from kf_benchmarks_tpu import tracing as tracing_lib
 from kf_benchmarks_tpu.serving import decode as decode_lib
 
@@ -117,13 +129,40 @@ class ServingEngine:
 
   def __init__(self, config: EngineConfig, variables=None,
                seed: int = 0, time_fn=time.monotonic,
-               sleep_fn=time.sleep):
+               sleep_fn=time.sleep, draft_variables=None):
     self.cfg = config
     self.spec = config.spec
     self._time = time_fn
     self._sleep = sleep_fn
-    self.variables = (variables if variables is not None
-                      else decode_lib.init_variables(self.spec, seed))
+    raw = (variables if variables is not None
+           else decode_lib.init_variables(self.spec, seed))
+    self.variables = decode_lib.prepare_variables(self.spec, raw)
+    # Speculative mode: the step loop (decode/prefill programs, the KV
+    # cache) runs the DRAFT; the target owns only the verify program.
+    # _step_spec/_step_vars are what every per-step codepath uses, so
+    # the non-speculative engine is the degenerate draft == target.
+    if self.spec.speculative_k:
+      self._draft = decode_lib.draft_spec(self.spec)
+      if draft_variables is None:
+        # Self-drafting default: the draft is the target's own first
+        # draft_n_layers (truncate_variables) -- a free draft whose
+        # early-layer features track the target far better than a
+        # random init ever would. Token identity holds for ANY draft;
+        # only the acceptance rate (and so the speedup) depends on it.
+        base = raw
+        if quantization.has_quantized_leaves(base):
+          base = quantization.dequantize_variables(base,
+                                                   self.spec.param_dtype)
+        draft_variables = decode_lib.truncate_variables(self.spec, base)
+      self.draft_variables = decode_lib.prepare_variables(
+          self._draft, draft_variables)
+      self._step_spec = self._draft
+      self._step_vars = self.draft_variables
+    else:
+      self._draft = None
+      self.draft_variables = None
+      self._step_spec = self.spec
+      self._step_vars = self.variables
     self._queue: collections.deque = collections.deque()
     self._results: Dict[Any, RequestResult] = {}
     self._order: List[Any] = []
@@ -132,6 +171,16 @@ class ServingEngine:
     self._slots: List[Optional[dict]] = []
     self._decode_exes: Dict[int, Any] = {}
     self._prefill_exes: Dict[int, Any] = {}
+    self._verify_exes: Dict[int, Any] = {}
+    # Paged-KV allocator state (spec.kv_page_size): the authoritative
+    # per-slot page tables are HOST numpy (scheduler metadata, shipped
+    # to each step as an argument); pool row 0 is the scratch page.
+    self._pps = self._step_spec.pages_per_slot
+    self._free_pages: List[int] = []
+    self._table_np = (np.zeros((0, self._pps), np.int32)
+                      if self._pps else None)
+    self._kv_pages_peak = 0
+    self._kv_fraction_peak = 0.0
     self._arrivals = 0
     self._shed = 0
     self._completed = 0
@@ -142,6 +191,10 @@ class ServingEngine:
     self._ticks = 0
     self._ttfts: List[float] = []
     self._token_lat: List[float] = []
+    self._spec_rounds = 0
+    self._draft_tokens = 0
+    self._accepted_tokens = 0
+    self._accept_lens: List[float] = []
     self._tenant_allowance: Dict[str, float] = {}
     self._tenant_last: Dict[str, float] = {}
     self._t_serve0: Optional[float] = None
@@ -172,6 +225,14 @@ class ServingEngine:
       self._shed_request(req, "empty_prompt")
       return False
     if prompt_len > self.spec.max_len:
+      self._shed_request(req, "prompt_too_long")
+      return False
+    if self.spec.speculative_k and (
+        prompt_len + self._max_new(req) + self.spec.speculative_k
+        > self.spec.max_len):
+      # Verify rows are history ++ proposals laid out flat in a
+      # (B, max_len) token batch -- no ring wrap exists for them, so
+      # the whole lifetime must fit the context up front.
       self._shed_request(req, "prompt_too_long")
       return False
     tokens = prompt_len + self._max_new(req)
@@ -238,8 +299,8 @@ class ServingEngine:
 
   def _decode_exe(self, bucket: int):
     if bucket not in self._decode_exes:
-      fn, args, donate = decode_lib.decode_lowering_args(self.spec,
-                                                         bucket)
+      fn, args, donate = decode_lib.decode_lowering_args(
+          self._step_spec, bucket)
       self._decode_exes[bucket] = self._compile(
           "serving_decode", bucket, fn, args, donate=donate)
     return self._decode_exes[bucket]
@@ -250,7 +311,7 @@ class ServingEngine:
     # forward even while a wide decode batch is in flight.
     if bucket not in self._prefill_exes:
       import jax
-      spec = self.spec
+      spec = self._step_spec
       var_sds = decode_lib.abstract_variables(spec)
       i32 = lambda: jax.ShapeDtypeStruct((bucket,), np.int32)
       args = (var_sds,
@@ -261,19 +322,35 @@ class ServingEngine:
           donate=())
     return self._prefill_exes[bucket]
 
+  def _verify_exe(self, bucket: int):
+    # The speculative TARGET's one program: the full spec (not the
+    # draft), undonated, keyed per decode bucket like the others --
+    # the bounded-compile ledger e2e counts serving_verify as its own
+    # <= len(ladder) family.
+    if bucket not in self._verify_exes:
+      fn, args, donate = decode_lib.verify_lowering_args(self.spec,
+                                                         bucket)
+      self._verify_exes[bucket] = self._compile(
+          "serving_verify", bucket, fn, args, donate=donate)
+    return self._verify_exes[bucket]
+
   def warm(self, buckets: Optional[Sequence[int]] = None) -> int:
-    """Precompile the decode + prefill executables for ``buckets``
-    (default: the whole ladder) BEFORE serving -- the `analysis warm`
-    discipline applied to the request path, so the first wave's TTFT
-    measures the system, not XLA. Returns the number of executables
-    compiled."""
+    """Precompile the decode + prefill (+ verify, when speculative)
+    executables for ``buckets`` (default: the whole ladder) BEFORE
+    serving -- the `analysis warm` discipline applied to the request
+    path, so the first wave's TTFT measures the system, not XLA.
+    Returns the number of executables compiled."""
     n = 0
     for b in (buckets if buckets is not None else self.cfg.bucket_ladder):
       b = bucket_for(int(b), self.cfg.bucket_ladder)
-      before = len(self._decode_exes) + len(self._prefill_exes)
+      before = (len(self._decode_exes) + len(self._prefill_exes)
+                + len(self._verify_exes))
       self._decode_exe(b)
       self._prefill_exe(b)
-      n += len(self._decode_exes) + len(self._prefill_exes) - before
+      if self.spec.speculative_k:
+        self._verify_exe(b)
+      n += (len(self._decode_exes) + len(self._prefill_exes)
+            + len(self._verify_exes) - before)
     return n
 
   # -- the serving loop -------------------------------------------------------
@@ -285,10 +362,25 @@ class ServingEngine:
     want = bucket_for(target, self.cfg.bucket_ladder)
     if want <= self._bucket:
       return
+    old_pool = (self._cache.k.shape[1] if self._cache is not None
+                else 0)
     if self._cache is None:
-      self._cache = decode_lib.init_cache(self.spec, want)
+      self._cache = decode_lib.init_cache(self._step_spec, want)
     else:
-      self._cache = decode_lib.grow_cache(self._cache, self.spec, want)
+      self._cache = decode_lib.grow_cache(self._cache, self._step_spec,
+                                          want)
+    if self._pps:
+      # Pool growth keeps old page ids valid (grow_cache copies the
+      # pool prefix); only the NEW rows join the free list.
+      new_pool = self._cache.k.shape[1]
+      if old_pool == 0:
+        self._free_pages = list(range(1, new_pool))
+        self._table_np = np.zeros((want, self._pps), np.int32)
+      else:
+        self._free_pages.extend(range(old_pool, new_pool))
+        grown = np.zeros((want, self._pps), np.int32)
+        grown[:self._table_np.shape[0]] = self._table_np
+        self._table_np = grown
     self._slots.extend([None] * (want - self._bucket))
     self._bucket = want
     metrics_lib.active().set("serving/decode_bucket", want)
@@ -308,21 +400,55 @@ class ServingEngine:
       self._bucket = 0
       self._cache = None
       self._slots = []
+      if self._pps:
+        self._free_pages = []
+        self._table_np = np.zeros((0, self._pps), np.int32)
       metrics_lib.active().set("serving/decode_bucket", 0)
       return
     target = bucket_for(len(active_idx), self.cfg.bucket_ladder)
     if target >= self._bucket:
       return
     import jax.numpy as jnp
-    # Pad rows duplicate slot 0's cache; they carry active=False, so
-    # their contents are never read and their writes land on the pad
-    # row only.
     keep = jnp.asarray(
         active_idx + [0] * (target - len(active_idx)), jnp.int32)
     cache = self._cache
-    self._cache = decode_lib.CacheState(
-        k=cache.k[:, keep], v=cache.v[:, keep],
-        pos=cache.pos[keep], tok=cache.tok[keep])
+    if self._pps:
+      # Paged shrink = page-pool compaction: live pages (in kept-slot
+      # order) remap onto the head of the smaller pool; slot tables
+      # rewrite to the new ids. Skip when the live set does not fit
+      # the target pool (a long-session tail can exceed the smaller
+      # pool's KV_POOL_FRACTION budget -- the ladder retries next
+      # tick once completions free pages).
+      new_pool = decode_lib.kv_pool_pages(self._step_spec, target)
+      live = [int(pid) for i in active_idx
+              for pid in self._table_np[i] if pid]
+      if 1 + len(live) > new_pool:
+        return
+      old_of = np.zeros((new_pool,), np.int32)   # new id -> old id
+      remap = {0: 0}
+      for new_id, pid in enumerate(live, start=1):
+        remap[pid] = new_id
+        old_of[new_id] = pid
+      gather = jnp.asarray(old_of)
+      table = np.zeros((target, self._pps), np.int32)
+      for row, i in enumerate(active_idx):
+        table[row] = [remap.get(int(pid), 0) for pid in self._table_np[i]]
+        # The slot's free-at-completion list must follow the remap, or
+        # _complete would return the OLD ids to the new pool.
+        self._slots[i]["pages"] = [remap[p]
+                                   for p in self._slots[i]["pages"]]
+      self._table_np = table
+      self._free_pages = list(range(1 + len(live), new_pool))
+      self._cache = decode_lib.CacheState(
+          k=cache.k[:, gather], v=cache.v[:, gather],
+          pos=cache.pos[keep], tok=cache.tok[keep])
+    else:
+      # Pad rows duplicate slot 0's cache; they carry active=False, so
+      # their contents are never read and their writes land on the pad
+      # row only.
+      self._cache = decode_lib.CacheState(
+          k=cache.k[:, keep], v=cache.v[:, keep],
+          pos=cache.pos[keep], tok=cache.tok[keep])
     self._slots = ([self._slots[i] for i in active_idx]
                    + [None] * (target - len(active_idx)))
     self._bucket = target
@@ -343,6 +469,16 @@ class ServingEngine:
       wave.append(req)
     return wave
 
+  def _pages_needed(self, prompt_len: int, max_new: int) -> int:
+    """Pages a request needs for its WHOLE lifetime (prompt + budget
+    + speculative lookahead) -- allocated once at prefill, so decode
+    never grows mid-flight. Capped at pages_per_slot: a fully
+    allocated slot has the dense slab's ring semantics exactly
+    (positions wrap inside its own pages)."""
+    page = self._step_spec.kv_page_size
+    need = prompt_len + max_new + self.spec.speculative_k
+    return min(self._pps, -(-need // page))
+
   def _prefill_wave(self, wave: List[Request]) -> None:
     from kf_benchmarks_tpu.data import packing as packing_lib
     import jax.numpy as jnp
@@ -354,13 +490,25 @@ class ServingEngine:
     prompts = [np.asarray(r.prompt, np.int32) for r in wave]
     packed_np, placements = packing_lib.pack_prompts(
         prompts, self.spec.max_len, pack_bucket)
-    placed: List[Tuple[Request, np.ndarray, Tuple[int, int]]] = []
+    placed: List[Tuple[Request, np.ndarray, Tuple[int, int], int]] = []
     overflow: List[Request] = []
+    avail_pages = len(self._free_pages) if self._pps else 0
     for req, prm, place in zip(wave, prompts, placements):
-      if place is None or len(placed) >= min(len(free), pack_bucket):
+      need = (self._pages_needed(prm.size, self._max_new(req))
+              if self._pps else 0)
+      if (place is None or len(placed) >= min(len(free), pack_bucket)
+          or need > avail_pages):
+        # Pool exhaustion lands here too: the request requeues and
+        # retries after in-flight completions free pages -- a shed
+        # path (TTFT-deadline expiry at the next coalesce if an SLO
+        # is set), never an exception. An EMPTY engine can never
+        # exhaust (kv_pool_pages floors at pages_per_slot + 1 and an
+        # idle engine resets to a fresh pool), so requeueing always
+        # makes progress.
         overflow.append(req)
       else:
-        placed.append((req, prm, place))
+        avail_pages -= need
+        placed.append((req, prm, place, need))
     # Requests that did not fit this wave's packed batch go back to
     # the queue HEAD in order (near-FIFO, like the packer's lookahead).
     for req in reversed(overflow):
@@ -373,52 +521,107 @@ class ServingEngine:
     last_pos = np.zeros((pack_bucket,), np.int32)
     lengths = np.zeros((pack_bucket,), np.int32)
     slots = np.full((pack_bucket,), self._bucket, np.int32)  # pad drops
-    for i, (req, prm, (row, off)) in enumerate(placed):
+    page_lists: List[List[int]] = []
+    if self._pps:
+      pool = self._cache.k.shape[1]
+      # Sentinel P on unallocated pages / pad rows: the install
+      # scatter drops them (mode="drop"); the engine-side table keeps
+      # 0 (the scratch page) there instead.
+      sent = np.full((pack_bucket, self._pps), pool, np.int32)
+    for i, (req, prm, (row, off), need) in enumerate(placed):
       rows[i], offsets[i] = row, off
       lengths[i] = prm.size
       last_pos[i] = off + prm.size - 1
       slots[i] = free[i]
+      if self._pps:
+        pages = [self._free_pages.pop() for _ in range(need)]
+        page_lists.append(pages)
+        self._table_np[free[i], :] = 0
+        self._table_np[free[i], :need] = pages
+        sent[i, :need] = pages
+    if self._pps:
+      in_use = self._cache.k.shape[1] - 1 - len(self._free_pages)
+      self._kv_pages_peak = max(self._kv_pages_peak, in_use)
+      self._kv_fraction_peak = max(
+          self._kv_fraction_peak,
+          in_use / max(self._cache.k.shape[1] - 1, 1))
+      reg = metrics_lib.active()
+      reg.set("serving/kv_pages_in_use", in_use)
+      reg.set("serving/kv_page_fraction",
+              in_use / max(self._cache.k.shape[1] - 1, 1))
     exe = self._prefill_exe(pack_bucket)
     trace = tracing_lib.active()
     with trace.span("serving", "prefill", requests=r,
                     bucket=pack_bucket):
-      first, ek, ev = exe(self.variables, jnp.asarray(packed_np),
+      first, ek, ev = exe(self._step_vars, jnp.asarray(packed_np),
                           jnp.asarray(rows), jnp.asarray(last_pos),
                           jnp.asarray(offsets))
-      self._cache = decode_lib.install_prefill(
-          self._cache, ek, ev, first, jnp.asarray(lengths),
-          jnp.asarray(slots))
+      if self._pps:
+        self._cache = decode_lib.install_prefill_paged(
+            self._cache, ek, ev, first, jnp.asarray(lengths),
+            jnp.asarray(slots), jnp.asarray(sent))
+      else:
+        self._cache = decode_lib.install_prefill(
+            self._cache, ek, ev, first, jnp.asarray(lengths),
+            jnp.asarray(slots))
       first_np = np.asarray(first)  # value dependency = completion
     now = self._time()
-    for i, (req, prm, _place) in enumerate(placed):
-      ttft = now - req.enqueue_t
-      self._ttfts.append(ttft)
-      trace.add_sample("serving/ttft", ttft)
-      slot = {"req": req, "tokens": [int(first_np[i])],
-              "t_first": now, "ttft": ttft}
+    for i, (req, prm, _place, _need) in enumerate(placed):
+      if self.spec.speculative_k:
+        # Speculative: the prefill ran the DRAFT, so its first token
+        # is a PROPOSAL, not an emission -- TTFT and the first real
+        # token come from the first verify round.
+        slot = {"req": req, "tokens": [], "history": prm.copy(),
+                "props": [int(first_np[i])],
+                "t_first": None, "ttft": None}
+      else:
+        ttft = now - req.enqueue_t
+        self._ttfts.append(ttft)
+        trace.add_sample("serving/ttft", ttft)
+        slot = {"req": req, "tokens": [int(first_np[i])],
+                "t_first": now, "ttft": ttft}
+      if self._pps:
+        slot["pages"] = page_lists[i]
       self._slots[free[i]] = slot
-      if len(slot["tokens"]) >= self._max_new(req):
-        self._complete(free[i], now)
-    self._tokens_out += r
+      if not self.spec.speculative_k:
+        if len(slot["tokens"]) >= self._max_new(req):
+          self._complete(free[i], now)
+        self._tokens_out += 1
+
+  def _run_decode_exe(self, active_np) -> np.ndarray:
+    """One batched decode dispatch on the step model (the draft, when
+    speculative); updates the cache in place and returns the sampled
+    tokens. Shared by the plain decode step and the speculative
+    draft-propose loop."""
+    import jax.numpy as jnp
+    exe = self._decode_exe(self._bucket)
+    cache = self._cache
+    if self._pps:
+      nxt, k, v, pos = exe(self._step_vars, cache.k, cache.v,
+                           cache.pos, cache.tok,
+                           jnp.asarray(self._table_np),
+                           jnp.asarray(active_np))
+    else:
+      nxt, k, v, pos = exe(self._step_vars, cache.k, cache.v,
+                           cache.pos, cache.tok,
+                           jnp.asarray(active_np))
+    nxt_np = np.asarray(nxt)  # value dependency = completion
+    self._cache = decode_lib.CacheState(k=k, v=v, pos=pos,
+                                        tok=jnp.asarray(nxt))
+    self._decode_steps += 1
+    metrics_lib.active().inc("serving/decode_steps")
+    return nxt_np
 
   def _decode_step(self) -> None:
-    import jax.numpy as jnp
     bucket = self._bucket
     active_np = np.array([s is not None for s in self._slots], np.bool_)
-    exe = self._decode_exe(bucket)
-    cache = self._cache
     trace = tracing_lib.active()
     t0 = self._time()
     with trace.span("serving", "decode_step",
                     active=int(active_np.sum()), bucket=bucket):
-      nxt, k, v, pos = exe(self.variables, cache.k, cache.v, cache.pos,
-                           cache.tok, jnp.asarray(active_np))
-      nxt_np = np.asarray(nxt)  # value dependency = completion
+      nxt_np = self._run_decode_exe(active_np)
     now = self._time()
     step_wall = now - t0
-    self._cache = decode_lib.CacheState(k=k, v=v, pos=pos,
-                                        tok=jnp.asarray(nxt))
-    self._decode_steps += 1
     self._last_step_t = now
     n_active = int(active_np.sum())
     self._fill_sum += n_active / max(bucket, 1)
@@ -426,7 +629,6 @@ class ServingEngine:
     trace.add_sample("serving/token_latency", step_wall)
     self._token_lat.append(step_wall)
     reg = metrics_lib.active()
-    reg.inc("serving/decode_steps")
     reg.set("serving/active", n_active)
     for i, slot in enumerate(self._slots):
       if slot is None:
@@ -435,9 +637,116 @@ class ServingEngine:
       if len(slot["tokens"]) >= self._max_new(slot["req"]):
         self._complete(i, now)
 
+  def _speculative_round(self) -> None:
+    """One draft-propose / target-verify round.
+
+    k-1 draft decode steps extend every active slot's proposal run
+    (slots fresh from prefill already hold the draft's first proposal,
+    so they offer k; slots continuing from a previous round offer
+    k-1). ONE target verify dispatch then scores every slot's row =
+    confirmed history ++ proposals, and the engine accepts the longest
+    agreeing prefix plus the verifier's own next token (the bonus) --
+    so every emitted token is the TARGET's greedy argmax and the
+    output is token-identical to plain greedy decode, whatever the
+    draft proposed.
+
+    Acceptance is capped at len(proposals)-1 so the accepted prefix
+    (whose K/V the draft wrote while proposing) plus the bonus
+    position (overwritten by the next draft step) never leaves a
+    confirmed position without draft K/V; the cap costs at most the
+    bonus-vs-final-proposal token, which the bonus replaces 1:1."""
+    import jax.numpy as jnp
+    trace = tracing_lib.active()
+    t0 = self._time()
+    bucket = self._bucket
+    active_np = np.array([s is not None for s in self._slots], np.bool_)
+    n_active = int(active_np.sum())
+    for _ in range(self.spec.speculative_k - 1):
+      nxt_np = self._run_decode_exe(active_np)
+      for i, slot in enumerate(self._slots):
+        if slot is not None:
+          slot["props"].append(int(nxt_np[i]))
+    rows_np = np.zeros((bucket, self.spec.max_len), np.int32)
+    for i, slot in enumerate(self._slots):
+      if slot is None:
+        continue
+      row = np.concatenate(
+          [slot["history"], np.asarray(slot["props"], np.int32)])
+      rows_np[i, :row.size] = row
+    exe = self._verify_exe(bucket)
+    with trace.span("serving", "verify", active=n_active,
+                    bucket=bucket):
+      preds = np.asarray(exe(self.variables, jnp.asarray(rows_np)))
+    now = self._time()
+    self._spec_rounds += 1
+    self._last_step_t = now
+    self._fill_sum += n_active / max(bucket, 1)
+    reg = metrics_lib.active()
+    reg.inc("serving/spec_rounds")
+    reg.set("serving/active", n_active)
+    new_pos = np.array(self._cache.pos)
+    new_tok = np.array(self._cache.tok)
+    emitted_total = 0
+    round_draft = round_accepted = 0
+    for i, slot in enumerate(list(self._slots)):
+      if slot is None:
+        continue
+      history, props = slot["history"], slot["props"]
+      q0 = history.size
+      # props[j] sits at row position q0+j; the target's greedy choice
+      # FOR that position is preds[i, q0+j-1] (preds[t] predicts t+1).
+      agree = 0
+      while (agree < len(props)
+             and props[agree] == preds[i, q0 + agree - 1]):
+        agree += 1
+      a = min(agree, len(props) - 1)
+      bonus = int(preds[i, q0 + a - 1])
+      emit = [int(x) for x in props[:a]] + [bonus]
+      room = self._max_new(slot["req"]) - len(slot["tokens"])
+      emit = emit[:room]
+      round_draft += len(props)
+      round_accepted += min(a, len(emit))
+      self._accept_lens.append(float(min(a, len(emit))))
+      trace.add_sample("serving/accept_len", float(min(a, len(emit))))
+      slot["tokens"].extend(emit)
+      slot["history"] = np.concatenate(
+          [history, np.asarray(emit, np.int32)])
+      slot["props"] = []
+      # Rewind the draft cache onto the confirmed row: the new tok is
+      # the last emitted token at position len(history')-1; the next
+      # draft step writes its K/V there (overwriting whatever rejected
+      # proposal K/V the draft had left).
+      new_pos[i] = slot["history"].size - 1
+      new_tok[i] = emit[-1]
+      if slot["t_first"] is None:
+        ttft = now - slot["req"].enqueue_t
+        slot["t_first"], slot["ttft"] = now, ttft
+        self._ttfts.append(ttft)
+        trace.add_sample("serving/ttft", ttft)
+      emitted_total += len(emit)
+      if len(slot["tokens"]) >= self._max_new(slot["req"]):
+        self._complete(i, now)
+    self._draft_tokens += round_draft
+    self._accepted_tokens += round_accepted
+    reg.inc("serving/draft_tokens", round_draft)
+    reg.inc("serving/accepted_tokens", round_accepted)
+    self._cache = decode_lib.CacheState(
+        k=self._cache.k, v=self._cache.v,
+        pos=jnp.asarray(new_pos), tok=jnp.asarray(new_tok))
+    self._tokens_out += emitted_total
+    per_tok = (now - t0) / max(emitted_total, 1)
+    self._token_lat.append(per_tok)
+    trace.add_sample("serving/token_latency", per_tok)
+
   def _complete(self, slot_idx: int, now: float) -> None:
     slot = self._slots[slot_idx]
     self._slots[slot_idx] = None
+    if self._pps:
+      # Free the slot's pages and point its table row at the scratch
+      # page: a freed slot's (inactive) decode writes land on scratch,
+      # never on a page a later request owns.
+      self._free_pages.extend(slot["pages"])
+      self._table_np[slot_idx, :] = 0
     req = slot["req"]
     self._completed += 1
     metrics_lib.active().inc("serving/completed")
@@ -470,7 +779,10 @@ class ServingEngine:
       if wave:
         self._prefill_wave(wave)
     if self._active_count():
-      self._decode_step()
+      if self.spec.speculative_k:
+        self._speculative_round()
+      else:
+        self._decode_step()
 
   def drain(self) -> List[RequestResult]:
     """Serve until queue and slots are empty; returns every result so
@@ -571,6 +883,28 @@ class ServingEngine:
         "serving/token_latency_p50": pct(self._token_lat, 50),
         "serving/token_latency_p90": pct(self._token_lat, 90),
         "serving/token_latency_p99": pct(self._token_lat, 99),
+        # Variant stats: None when the variant is off (the publish
+        # path drops None, so variant-off runs report exactly the
+        # pre-variant key set).
+        "serving/kv_pages_in_use": (self._kv_pages_peak
+                                    if self._pps else None),
+        "serving/kv_page_fraction": (self._kv_fraction_peak
+                                     if self._pps else None),
+        "serving/spec_rounds": (self._spec_rounds
+                                if self.spec.speculative_k else None),
+        "serving/draft_tokens": (self._draft_tokens
+                                 if self.spec.speculative_k else None),
+        "serving/accepted_tokens": (
+            self._accepted_tokens if self.spec.speculative_k else None),
+        "serving/accept_len_p50": (
+            pct(self._accept_lens, 50)
+            if self.spec.speculative_k else None),
+        "serving/accept_len_p90": (
+            pct(self._accept_lens, 90)
+            if self.spec.speculative_k else None),
+        "serving/accept_len_p99": (
+            pct(self._accept_lens, 99)
+            if self.spec.speculative_k else None),
     }
     return out
 
@@ -598,7 +932,9 @@ def poisson_workload(n: int, rate_per_s: float, spec: decode_lib.LMSpec,
   workload, the A/B and regression-comparison contract."""
   from kf_benchmarks_tpu.data import packing as packing_lib
   rng = np.random.default_rng(seed)
-  cap = max(1, spec.max_len - max_new_tokens - 1)
+  # Speculative specs need prompt + max_new + k to fit the context
+  # (verify rows never wrap), so the prompt cap shrinks by k.
+  cap = max(1, spec.max_len - max_new_tokens - spec.speculative_k - 1)
   lengths = np.minimum(
       packing_lib.sample_document_lengths(
           rng, n, spec.max_len, mean_fraction=mean_prompt_fraction),

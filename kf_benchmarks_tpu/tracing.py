@@ -74,10 +74,12 @@ SUBSYSTEMS = ("run", "compile", "dispatch", "device", "feed",
               "serving")
 
 # Canonical latency-sample keys (the percentile lines / stats fields).
-# The serving/* pair comes from the request engine: TTFT per request,
-# decode-step wall per emitted token (serving/engine.py).
+# The serving/* entries come from the request engine: TTFT per request,
+# decode-step wall per emitted token, and the accepted speculative
+# prefix length per slot per verify round (serving/engine.py).
 SAMPLE_KEYS = ("chunk_wall", "feed_wait", "checkpoint_save",
-               "serving/ttft", "serving/token_latency")
+               "serving/ttft", "serving/token_latency",
+               "serving/accept_len")
 
 # Reported quantiles. Every ``<key>_p<q>`` stats/bench-JSON field is
 # SAMPLE_KEYS x QUANTILES; the metric registry (metrics.py) registers

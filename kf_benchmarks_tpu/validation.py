@@ -227,6 +227,33 @@ def validate_cross_flags(params) -> None:
     raise ParamError(
         f"--serving_batching={batching!r}: expected 'continuous' "
         "(in-flight batching) or 'static' (batch-and-drain)")
+  # Decode-cost variants (ISSUE 16). serving_quantize carries its
+  # whole contract in the registry enum; the two below cross flags.
+  page = getattr(p, "serving_kv_page_size", None)
+  if page is not None:
+    # The serving context length defaults to the zoo transformer_lm's
+    # SEQ_LEN (serving/decode.py LMSpec.max_len); LMSpec.__post_init__
+    # re-validates against the per-spec max_len when a caller
+    # overrides it.
+    from kf_benchmarks_tpu.models import transformer_lm as _lm
+    if _lm.SEQ_LEN % page:
+      raise ParamError(
+          f"--serving_kv_page_size={page} must divide the serving "
+          f"context length ({_lm.SEQ_LEN}): partial pages would break "
+          "the page-table <-> ring position bijection "
+          "(serving/decode.py)")
+  spec_k = getattr(p, "serving_speculative_k", None)
+  draft_layers = getattr(p, "serving_draft_layers", None)
+  if spec_k is not None and draft_layers is None:
+    raise ParamError(
+        f"--serving_speculative_k={spec_k} requires a draft spec: set "
+        "--serving_draft_layers (< the served model's layer count; "
+        "serving/decode.py draft_spec)")
+  if draft_layers is not None and spec_k is None:
+    raise ParamError(
+        f"--serving_draft_layers={draft_layers} is inert without "
+        "--serving_speculative_k (the draft only runs inside "
+        "speculative rounds)")
   if p.num_batches is not None and p.num_batches <= 0:
     raise ParamError("--num_batches must be positive")
   if (getattr(p, "steps_per_dispatch", 1) or 1) > 1:
