@@ -72,6 +72,17 @@ def main(argv=None):
   parser.add_argument("--serving_draft_layers", type=int, default=None,
                       help="serving: draft model depth for speculative "
                            "decoding (< the spec's n_layers)")
+  parser.add_argument("--serving_model_shards", type=int, default=None,
+                      help="serving: tensor-parallel shard count for "
+                           "the decode/prefill/verify executables "
+                           "(>= 2, must divide the spec's head count; "
+                           "--serving_model_shards params passthrough)")
+  parser.add_argument("--partitioner", default=None,
+                      choices=("manual", "gspmd"),
+                      help="training bench: who inserts the sharded "
+                           "step's collectives (--partitioner params "
+                           "passthrough; program-shaping, so the run "
+                           "keys apart from default history)")
   parser.add_argument("--metrics_port", type=int, default=None,
                       help="serving: bind the live /metrics + /healthz "
                            "endpoint for the duration of the replay")
@@ -149,6 +160,8 @@ def main(argv=None):
   bench_kwargs = metrics_lib.bench_params_kwargs(on_tpu)
   if args.autotuned_config:
     bench_kwargs["autotuned_config"] = args.autotuned_config
+  if args.partitioner:
+    bench_kwargs["partitioner"] = args.partitioner
   params = params_lib.make_params(**bench_kwargs)
   # setup() applies --autotuned_config (with the provenance line), so
   # the params this process fingerprints below are the APPLIED ones.
@@ -198,6 +211,13 @@ def main(argv=None):
       # field rides every BENCH_* line so packed/real-data trajectories
       # record it uniformly (_CPU_FALLBACK semantics unchanged).
       "feed_stall_fraction": stats.get("feed_stall_fraction"),
+      # Who inserted the sharded step's collectives (ISSUE 17):
+      # "manual" = the hand-written shard_map programs (the default,
+      # also when the flag is unset), "gspmd" = plain jit +
+      # NamedShardings with the XLA SPMD partitioner choosing the
+      # exchange. Program-shaping (the flag is in the fingerprint), so
+      # twin runs never mix in the regression gate.
+      "partitioner": params.partitioner or "manual",
   }
   # Streaming latency percentiles + compile ledger (tracing.py): the
   # SLO-telemetry and compile-cache groundwork fields (ROADMAP items 2
@@ -278,13 +298,16 @@ def run_serving_bench(args, on_tpu, attempts) -> int:
 
   params = params_lib.make_params(
       model="transformer_lm", device="tpu" if on_tpu else "cpu",
-      num_devices=1,
+      # The serving 'model' mesh draws whole devices, so a TP bench
+      # claims exactly model_shards of them (dense stays single-device).
+      num_devices=max(1, args.serving_model_shards or 1),
       serving_bucket_ladder=args.serving_bucket_ladder,
       serving_batching=args.serving_batching,
       serving_quantize=args.serving_quantize,
       serving_kv_page_size=args.serving_kv_page_size,
       serving_speculative_k=args.serving_speculative_k,
-      serving_draft_layers=args.serving_draft_layers)
+      serving_draft_layers=args.serving_draft_layers,
+      serving_model_shards=args.serving_model_shards)
   # Cross-flag contract (validation.py): an inconsistent variant combo
   # (speculative without a draft, a non-dividing page size) fails at
   # parse time with the named flag, not mid-serve inside LMSpec.
@@ -301,6 +324,8 @@ def run_serving_bench(args, on_tpu, attempts) -> int:
   if p.serving_speculative_k:
     variant_kw["speculative_k"] = p.serving_speculative_k
     variant_kw["draft_n_layers"] = p.serving_draft_layers
+  if p.serving_model_shards:
+    variant_kw["model_shards"] = p.serving_model_shards
   if on_tpu:
     spec = LMSpec(**variant_kw)
     n_req, rate, max_new = 128, 16.0, 32
@@ -382,7 +407,8 @@ def run_serving_bench(args, on_tpu, attempts) -> int:
       # variant runs never mix with dense/bf16 history.
       "decode_variant": {"quantize": spec.quantize,
                          "paged_kv": spec.kv_page_size or None,
-                         "speculative_k": spec.speculative_k or None},
+                         "speculative_k": spec.speculative_k or None,
+                         "model_shards": spec.model_shards or None},
   }
   if quantize_gate is not None:
     # The measured accuracy evidence behind the int8 decision: if the

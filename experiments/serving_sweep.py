@@ -37,6 +37,18 @@ INT8.
 
     python experiments/serving_sweep.py --variants [--requests 48]
         [--rate 80] [--ladder 1,4,16] [--seed 0]
+
+Mode 4 (``--tp``; round 20, ISSUE 17) A/Bs tensor-parallel decode
+(--serving_model_shards: Megatron-sharded projections + head-sharded
+KV cache over the 'model' mesh, serving/decode.py tp_shardings)
+against the single-replica arm on the SAME seeded workload: exact
+greedy token identity is the correctness verdict (argmax absorbs the
+documented ~2e-6 psum reassociation), and the table reports tok/s,
+p99 TTFT, and per-device weight/KV-cache bytes (the memory win TP
+exists for: the sharded matrices hold 1/M per device).
+
+    python experiments/serving_sweep.py --tp [--shards 2 4]
+        [--requests 48] [--rate 80] [--ladder 1,4,16] [--seed 0]
 """
 
 from __future__ import annotations
@@ -415,6 +427,130 @@ def variants_ab(args):
   return 0 if all(verdicts.values()) else 1
 
 
+def tp_ab(args):
+  """Tensor-parallel serving decode vs the single-replica arm
+  (ISSUE 17), in-process on the CPU mesh (the chip rows ride the
+  standing tunnel campaign). Same seeded workload + same UNSHARDED
+  init for every arm, so exact token identity is well-posed."""
+  if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+  if args.engine_device == "cpu":
+    # The TP mesh needs max(shards) devices: provision the virtual CPU
+    # pool BEFORE jax initializes (the tests/conftest.py recipe), then
+    # flip the platform through jax.config (CLAUDE.md: overriding the
+    # pinned JAX_PLATFORMS env breaks the relay).
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") +
+        " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+  import dataclasses
+  import json
+
+  import jax
+  import numpy as np
+
+  from kf_benchmarks_tpu.serving import decode as decode_lib
+  from kf_benchmarks_tpu.serving import (EngineConfig, ServingEngine,
+                                         poisson_workload)
+  from kf_benchmarks_tpu.validation import parse_bucket_ladder
+
+  base = dict(vocab=512, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+              max_len=128, attn_block=32)
+  ladder = parse_bucket_ladder(args.ladder)
+  cap_spec = decode_lib.LMSpec(**base)
+  workload = poisson_workload(args.requests, args.rate, cap_spec,
+                              seed=args.seed,
+                              max_new_tokens=args.max_new)
+  variables = decode_lib.init_variables(cap_spec, seed=args.seed)
+
+  def per_device_bytes(tree):
+    # Addressable shard on device 0: sharded matrices count 1/M,
+    # replicated leaves count whole -- the serving HBM claim per chip.
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+      shards = getattr(leaf, "addressable_shards", None)
+      total += (shards[0].data.nbytes if shards else leaf.nbytes)
+    return total
+
+  arms = [("dense", 0)] + [(f"tp{m}", m) for m in args.shards]
+  results = {}
+  for name, m in arms:
+    spec = decode_lib.LMSpec(**base,
+                             **({"model_shards": m} if m else {}))
+    cfg = EngineConfig(spec=spec, bucket_ladder=ladder,
+                       max_new_tokens=args.max_new,
+                       max_queue_depth=args.requests + 1)
+    # Warm replay first (same hygiene as engine_ab/variants_ab: the
+    # cache scatter combos compile lazily per shape pair).
+    warm = ServingEngine(cfg, variables=variables, seed=args.seed)
+    warm.warm()
+    warm.replay([(t, dataclasses.replace(r)) for t, r in workload])
+    eng = ServingEngine(cfg, variables=variables, seed=args.seed)
+    eng.warm()
+    t0 = time.time()
+    res = eng.replay([(t, dataclasses.replace(r)) for t, r in workload])
+    wall = time.time() - t0
+    # Weights measured AS THE EXECUTABLE CONSUMES them: the engine's
+    # host tree stays whole (place_serving_args re-pins per dispatch),
+    # so the per-device claim is the placed tree's device-0 shards --
+    # column/row-parallel matrices 1/M, embeddings/LNs/head replicated.
+    ins, _ = decode_lib.tp_shardings(spec, "serving_decode",
+                                     max(ladder))
+    placed_vars = (jax.device_put(eng._step_vars, ins[0]) if ins
+                   else eng._step_vars)
+    results[name] = {
+        "tokens": {r.rid: list(r.tokens) for r in res
+                   if r.status == "ok"},
+        "stats": eng.stats(), "wall_s": wall,
+        "weight_bytes_per_device": per_device_bytes(placed_vars),
+        "kv_bytes_per_device": (
+            per_device_bytes([eng._cache.k, eng._cache.v])
+            if eng._cache is not None else 0),
+    }
+
+  dense = results["dense"]["tokens"]
+  verdicts = {}
+  for name, m in arms[1:]:
+    got = results[name]["tokens"]
+    verdicts[name] = set(got) == set(dense) and all(
+        got[rid] == dense[rid] for rid in dense)
+
+  print("\n| arm | tok/s | ttft p99 ms | weights/device MB | "
+        "kv/device KB |")
+  print("|---|---|---|---|---|")
+  for name, _ in arms:
+    s = results[name]["stats"]
+    print(f"| {name} | {s['serving/tokens_per_sec']:.0f} | "
+          f"{1e3 * s['serving/ttft_p99']:.1f} | "
+          f"{results[name]['weight_bytes_per_device'] / 1e6:.2f} | "
+          f"{results[name]['kv_bytes_per_device'] / 1e3:.0f} |")
+  for name, ok in verdicts.items():
+    print(f"verdict {name}: exact token identity vs dense -> "
+          + ("PASS" if ok else "FAIL"), flush=True)
+
+  record = {
+      "metric": "serving_tensor_parallel",
+      "value": round(min(
+          results[f"tp{m}"]["stats"]["serving/tokens_per_sec"] /
+          results["dense"]["stats"]["serving/tokens_per_sec"]
+          for m in args.shards), 4),
+      "unit": "tp_over_dense_tokens_per_sec",
+      "requests": args.requests, "rate": args.rate,
+      "max_new_tokens": args.max_new, "ladder": list(ladder),
+      "seed": args.seed,
+      "arms": {name: {"stats": results[name]["stats"],
+                      "wall_s": round(results[name]["wall_s"], 3),
+                      "weight_bytes_per_device":
+                          results[name]["weight_bytes_per_device"],
+                      "kv_bytes_per_device":
+                          results[name]["kv_bytes_per_device"]}
+               for name, _ in arms},
+  }
+  print(json.dumps(record), flush=True)
+  return 0 if all(verdicts.values()) else 1
+
+
 def main():
   ap = argparse.ArgumentParser(description=__doc__)
   ap.add_argument("--model", default="resnet50")
@@ -442,8 +578,17 @@ def main():
                        "paged KV / speculative / composed vs the "
                        "dense arm on the SAME seeded workload)")
   ap.add_argument("--rate", type=float, default=80,
-                  help="variants A/B: offered arrival rate, req/s")
+                  help="variants/tp A/B: offered arrival rate, req/s")
+  ap.add_argument("--tp", action="store_true",
+                  help="run the tensor-parallel serving A/B "
+                       "(--serving_model_shards arms vs the single-"
+                       "replica arm on the SAME seeded workload)")
+  ap.add_argument("--shards", type=int, nargs="+", default=[2, 4],
+                  help="tp A/B: model-shard counts (each must divide "
+                       "the spec's head count and the device pool)")
   args = ap.parse_args()
+  if args.tp:
+    raise SystemExit(tp_ab(args))
   if args.variants:
     raise SystemExit(variants_ab(args))
   if args.engine:

@@ -111,8 +111,9 @@ def run_audit(args) -> int:
   # (the engine's AOT decode program), audited by the same rule engine.
   serving_contracts = {}
   for name in serving_names:
-    contract = contracts.trace_serving_contract(
-        dict(contracts.SERVING_GOLDEN_CONFIGS[name]))
+    cfg = dict(contracts.SERVING_GOLDEN_CONFIGS[name])
+    program = cfg.get("program", "serving_decode")
+    contract = tracer(cfg, program)
     serving_contracts[name] = contract
     violations = audit.audit_contract(contract, tracer)
     report["configs"][name] = {
@@ -122,6 +123,11 @@ def run_audit(args) -> int:
         "in_loop_collectives": len(contract.in_loop_collectives()),
         "gradient_collectives": len(contract.gradient_collectives()),
     }
+    twin_cfg = audit._twin_manual_config(contract)
+    if twin_cfg is not None:
+      report["configs"][name]["partitioner_twin"] = (
+          audit.partitioner_twin_verdict(
+              contract, tracer(twin_cfg, contract.program)))
     report["violations"] += len(violations)
 
   diff_total = 0

@@ -61,6 +61,11 @@ def rule_accum_one_collective(contract, tracer):
   literally one."""
   if _accum(contract) <= 1:
     return []
+  if _gspmd(contract):
+    # GSPMD places the gradient exchange itself; the twin referee's
+    # accum leg owns the in-loop check against the manual twin
+    # (rule_partitioner_twin; one owner per seeded violation).
+    return []
   out = []
   grads = contract.gradient_collectives()
   in_loop = [c for c in grads if c.in_loop]
@@ -81,7 +86,15 @@ def rule_overlap_in_backward(contract, tracer):
 
   Overlap ON with a scanned-layers model: the per-block collective must
   sit INSIDE the backward scan's while body. Overlap OFF (or hooks
-  disengaged under --num_grad_accum): NO collective may be in-loop."""
+  disengaged under --num_grad_accum): NO collective may be in-loop.
+  Manual TRAIN programs only: GSPMD decides collective placement
+  itself (in-or-out of the scanned backward), so the twin referee
+  owns that program shape (rule_partitioner_twin) -- and a tensor-
+  parallel serving program's per-block reductions live inside the
+  layer scan by construction (same owner)."""
+  if _gspmd(contract) or contract.program not in ("train_step",
+                                                  "train_chunk"):
+    return []
   engaged = _overlap(contract) and _accum(contract) == 1
   in_loop = contract.in_loop_collectives()
   if not engaged:
@@ -233,6 +246,17 @@ def _sharded(contract) -> bool:
   return bool(_cfg(contract, "shard_optimizer_state", False))
 
 
+def _gspmd(contract) -> bool:
+  """True when the contract's program was partitioned by GSPMD
+  (--partitioner=gspmd). The hand-written collective-shape rules
+  (sharded exchange kinds, FSDP gather residency, replica-group
+  shapes) encode the MANUAL shard_map program; GSPMD is free to pick
+  a different-but-correct exchange, so those rules stand down and
+  rule_partitioner_twin referees the divergence instead (one owner
+  per seeded violation)."""
+  return _cfg(contract, "partitioner") == "gspmd"
+
+
 def _group_sizes(replica_groups: str):
   """Parse an HLO ``{{0,1},{2,3}}`` replica-groups string into the list
   of group sizes (empty when the attribute was absent)."""
@@ -249,8 +273,9 @@ def rule_sharded_collectives(contract, tracer):
   all-reduce may remain (the ZeRO exchange, ops/sharded.py), each
   reduce-scatter group spans the 'batch' axis (B data replicas) and
   each all-gather group the whole mesh, and f32 training keeps f32
-  wires on both."""
-  if not _sharded(contract):
+  wires on both. Binds only on the MANUAL partitioner's programs --
+  GSPMD may legally choose a different exchange (see _gspmd)."""
+  if not _sharded(contract) or _gspmd(contract):
     return []
   out = []
   rs = [c for c in contract.collectives
@@ -317,6 +342,10 @@ def rule_sharded_opt_bytes(contract, tracer):
   # ... and --shard_params requires --shard_optimizer_state, so the
   # replicated twin drops it with the rest.
   twin_cfg.pop("shard_params", None)
+  # ... and --partitioner=gspmd requires sharded state too (the twin
+  # is the plain replicated program either way -- the ZeRO bound is
+  # about the state bytes, not who inserted the collectives).
+  twin_cfg.pop("partitioner", None)
   twin = tracer(twin_cfg, contract.program)
   full = twin.aux.get("opt_state_bytes_per_device")
   if full is None:
@@ -351,8 +380,11 @@ def rule_fsdp_residency(contract, tracer):
   every live re-assembled param buffer is bucket/block-sized. Under
   --num_grad_accum the in-compute gathers disengage by design (one
   whole-tree gather per step, train_step.py), so only the size bound
-  binds there."""
-  if not _fsdp(contract) or contract.program != "train_step":
+  binds there. Manual-partitioner programs only (see _gspmd) -- the
+  gspmd twin's residency is refereed by rule_partitioner_twin's
+  largest-live-buffer bound against this very program."""
+  if not _fsdp(contract) or contract.program != "train_step" or \
+      _gspmd(contract):
     return []
   out = []
   full_bytes = contract.aux.get("fsdp_param_full_bytes")
@@ -436,6 +468,154 @@ def rule_packed_no_overhead(contract, tracer):
         f"unpacked twin's {n_grad_off} -- packing must not touch the "
         "gradient exchange")
   return out
+
+
+def _twin_inventory(contract):
+  """Collective inventory keyed on (kind, dtype, rank, placement):
+  count, total wire bytes, and the replica-group sizes seen -- the
+  rows the partitioner referee diffs between the twins."""
+  rows: Dict[tuple, Dict[str, Any]] = {}
+  for c in contract.collectives:
+    key = (c.kind, c.dtype, "scalar" if c.scalar else "tensor",
+           "in_loop" if c.in_loop else "top_level")
+    row = rows.setdefault(key, {"count": 0, "bytes": 0, "groups": set()})
+    row["count"] += 1
+    row["bytes"] += _collective_bytes(c)
+    if c.replica_groups:
+      row["groups"].update(_group_sizes(c.replica_groups))
+  return rows
+
+
+def _twin_wire_bytes(inventory) -> int:
+  """Total non-scalar wire bytes an inventory moves (scalar control
+  reductions are noise at any partitioner's scale)."""
+  return sum(row["bytes"] for (k, d, r, p), row in inventory.items()
+             if r == "tensor")
+
+
+def partitioner_twin_verdict(contract, twin) -> Dict[str, Any]:
+  """ISSUE 17: the twin referee. Diff the gspmd contract against its
+  manual twin -- collective inventory (kind/wire/elems/groups/in-loop
+  placement) and largest live buffer -- and CLASSIFY the divergence:
+
+  - ``equivalent``: identical inventory rows and buffer within 5%.
+  - ``manual-wins`` / ``gspmd-wins``: the programs legitimately
+    diverge (GSPMD chose a different exchange); the side moving fewer
+    wire bytes (buffer as tiebreak) wins. Not a violation -- the diff
+    table IS the deliverable (PERF.md reads it from the report).
+  - ``bug``: a divergence no partitioner choice explains -- a host
+    transfer only the gspmd side carries, donation lost, a gradient
+    collective re-entering the microbatch scan, or the largest live
+    buffer blowing past 2x the manual twin's. These violate.
+
+  Returns the machine-readable verdict dict embedded in the audit
+  report (classification, per-row diff, buffer ratio, bug messages)."""
+  inv_g = _twin_inventory(contract)
+  inv_m = _twin_inventory(twin)
+  rows = []
+  for key in sorted(set(inv_g) | set(inv_m), key=repr):
+    g, m = inv_g.get(key), inv_m.get(key)
+    if g == m:
+      continue
+    kind, dtype, rank, placement = key
+    rows.append({
+        "kind": kind, "dtype": dtype, "rank": rank,
+        "placement": placement,
+        "manual": {"count": m["count"], "bytes": m["bytes"],
+                   "groups": sorted(m["groups"])} if m else None,
+        "gspmd": {"count": g["count"], "bytes": g["bytes"],
+                  "groups": sorted(g["groups"])} if g else None,
+    })
+  bytes_g, bytes_m = _twin_wire_bytes(inv_g), _twin_wire_bytes(inv_m)
+  buf_g = contract.largest_tensor_bytes
+  buf_m = twin.largest_tensor_bytes
+  buf_ratio = (buf_g / buf_m) if buf_m else None
+
+  bugs = []
+  extra_host = [h for h in contract.host_transfers
+                if h not in twin.host_transfers]
+  if extra_host:
+    bugs.append(f"gspmd-only host transfer(s) {extra_host} -- GSPMD "
+                "smuggled a host round-trip into the step the manual "
+                "program does without")
+  if twin.donated_buffers > 0 and contract.donated_buffers == 0:
+    bugs.append("manual twin donates its state but the gspmd program "
+                "lost the aliasing -- HBM footprint doubles under "
+                "GSPMD for no partitioning reason")
+  if _accum(contract) > 1:
+    grads_in_loop_g = [c for c in contract.gradient_collectives()
+                       if c.in_loop]
+    grads_in_loop_m = [c for c in twin.gradient_collectives()
+                       if c.in_loop]
+    if grads_in_loop_g and not grads_in_loop_m:
+      bugs.append(
+          f"{len(grads_in_loop_g)} gradient collective(s) inside the "
+          "microbatch scan on the gspmd side only -- GSPMD moved the "
+          "once-per-step reduction into the per-microbatch body")
+  if buf_m and buf_g > 2 * buf_m:
+    bugs.append(
+        f"gspmd largest live buffer {contract.largest_tensor_type} "
+        f"({buf_g} B) blows past 2x the manual twin's "
+        f"{twin.largest_tensor_type} ({buf_m} B) -- GSPMD "
+        "materialized something the manual program keeps sharded")
+
+  if bugs:
+    classification = "bug"
+  elif not rows and (buf_ratio is None or 0.95 <= buf_ratio <= 1.05):
+    classification = "equivalent"
+  elif bytes_g < bytes_m or (bytes_g == bytes_m and buf_g < buf_m):
+    classification = "gspmd-wins"
+  elif bytes_m < bytes_g or (bytes_g == bytes_m and buf_m < buf_g):
+    classification = "manual-wins"
+  else:
+    classification = "equivalent"
+  return {
+      "classification": classification,
+      "inventory_diff": rows,
+      "wire_bytes": {"manual": bytes_m, "gspmd": bytes_g},
+      "largest_buffer": {"manual": buf_m, "gspmd": buf_g,
+                         "ratio": buf_ratio},
+      "bugs": bugs,
+  }
+
+
+def _twin_manual_config(contract) -> Optional[Dict[str, Any]]:
+  """The manual twin's config for a gspmd-side contract, or None when
+  the referee does not bind. Train programs: the config carries
+  ``partitioner='gspmd'``; the twin drops the flag (manual is the
+  default). Serving programs: the config carries ``model_shards``; the
+  twin is the unsharded decode of the same spec."""
+  if contract.program in ("train_step", "train_chunk"):
+    if _cfg(contract, "partitioner") != "gspmd":
+      return None
+    twin_cfg = dict(contract.config)
+    twin_cfg.pop("partitioner")
+    return twin_cfg
+  if contract.program in ("serving_decode", "serving_verify"):
+    if not _cfg(contract, "model_shards"):
+      return None
+    twin_cfg = dict(contract.config)
+    twin_cfg.pop("model_shards")
+    return twin_cfg
+  return None
+
+
+def rule_partitioner_twin(contract, tracer):
+  """ISSUE 17: the gspmd/manual twin referee. A --partitioner=gspmd
+  step (or a model-sharded serving decode) is the SAME math lowered
+  through GSPMD's propagation instead of the hand-written shard_map
+  collectives; the referee traces the manual twin, diffs collective
+  inventory + largest live buffer, and classifies
+  (partitioner_twin_verdict). Only the ``bug`` class violates --
+  equivalent/manual-wins/gspmd-wins are legitimate partitioner
+  divergences the report tables for PERF.md."""
+  twin_cfg = _twin_manual_config(contract)
+  if twin_cfg is None or tracer is None:
+    return []
+  twin = tracer(twin_cfg, contract.program)
+  verdict = partitioner_twin_verdict(contract, twin)
+  return [f"gspmd/manual twin divergence classified as a BUG: {msg}"
+          for msg in verdict["bugs"]]
 
 
 def rule_serving_bounded_decode(contract, tracer):
@@ -610,8 +790,10 @@ def rule_full_mesh_replica_groups(contract, tracer):
   sharded mesh with a model axis, the metric pmeans legitimately span
   the BATCH axis only (M groups of B devices; model-axis peers hold
   identical values), so groups of exactly num_data_replicas are also
-  admitted there."""
-  if not _replicated_sync(contract):
+  admitted there. Manual programs only: GSPMD derives its own group
+  shapes from the sharding propagation (rule_partitioner_twin diffs
+  them against the manual twin's)."""
+  if not _replicated_sync(contract) or _gspmd(contract):
     return []
   n = contract.aux.get("num_devices")
   if not n:
@@ -693,6 +875,7 @@ RULES: Dict[str, Callable] = {
     "no-btv-buffer": rule_no_btv_buffer,
     "health-no-extra-collective": rule_health_no_extra_collective,
     "wire-dtype": rule_wire_dtype,
+    "partitioner-twin": rule_partitioner_twin,
     "sharded-collectives": rule_sharded_collectives,
     "sharded-opt-bytes": rule_sharded_opt_bytes,
     "fsdp-residency": rule_fsdp_residency,
@@ -731,7 +914,15 @@ def make_memo_tracer() -> Callable:
   def tracer(overrides, program="train_step"):
     key = repr(sorted(overrides.items())) + program
     if key not in memo:
-      memo[key] = contracts_lib.trace_contract(dict(overrides), program)
+      if program.startswith("serving"):
+        # Serving contracts lower through the engine's own AOT recipe
+        # (LMSpec overrides), not make_params -- route them so paired
+        # rules (the partitioner-twin referee) can trace serving twins
+        # through the same memo.
+        memo[key] = contracts_lib.trace_serving_contract(
+            dict(overrides), program)
+      else:
+        memo[key] = contracts_lib.trace_contract(dict(overrides), program)
     return memo[key]
 
   return tracer
@@ -753,5 +944,13 @@ def audit_configs(configs: Dict[str, Dict[str, Any]],
         "in_loop_collectives": len(contract.in_loop_collectives()),
         "gradient_collectives": len(contract.gradient_collectives()),
     }
+    twin_cfg = _twin_manual_config(contract)
+    if twin_cfg is not None:
+      # The referee's full verdict rides the report (PERF.md's twin
+      # inventory-diff table is generated from it); only the "bug"
+      # class fed report["violations"] above.
+      report["configs"][name]["partitioner_twin"] = (
+          partitioner_twin_verdict(contract,
+                                   tracer(twin_cfg, contract.program)))
     report["violations"] += len(violations)
   return report

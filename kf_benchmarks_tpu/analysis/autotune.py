@@ -121,6 +121,12 @@ def default_axes(base_params) -> "collections.OrderedDict[str, tuple]":
   if getattr(base_params, "data_dir", None) or \
       bool(getattr(base_params, "packed_sequences", False)):
     axes["input_prefetch_depth"] = (None, 2, 4)
+  # The gspmd twin is only legal where the manual program shards
+  # something (validation.py rejects it elsewhere) -- same families
+  # the twin-referee audits.
+  if bool(getattr(base_params, "shard_optimizer_state", False)) or \
+      bool(getattr(base_params, "shard_params", False)):
+    axes["partitioner"] = (None, "gspmd")
   return axes
 
 
@@ -524,6 +530,11 @@ def validate_table(table: Dict[str, Any], *,
       if k not in TUNED_KNOBS:
         problems.append(f"{where}: tuned knob {k!r} is not in the "
                         f"knob registry {list(TUNED_KNOBS)}")
+      elif k == "partitioner":
+        # The one string-valued knob (see baseline.TUNED_KNOBS).
+        if v is not None and v not in ("manual", "gspmd"):
+          problems.append(f"{where}: tuned value partitioner={v!r} is "
+                          "not 'manual', 'gspmd', or null")
       elif v is not None and (isinstance(v, bool)
                               or not isinstance(v, int)):
         problems.append(f"{where}: tuned value {k}={v!r} is not an "

@@ -102,6 +102,11 @@ TUNED_KNOBS = (
     "reduce_bucket_mb",
     "input_prefetch_depth",
     "attn_block",
+    # Round 20: who inserts the sharded step's collectives -- None/
+    # "manual" (hand-written shard_map programs) or "gspmd" (plain jit
+    # + NamedShardings, XLA SPMD chooses). The one string-valued knob:
+    # the table validator admits {"manual","gspmd"} for it only.
+    "partitioner",
 )
 
 # Run-length counters: in the full fingerprint (the LR schedule can

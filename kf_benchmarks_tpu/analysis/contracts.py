@@ -503,6 +503,40 @@ GOLDEN_CONFIGS: "OrderedDict[str, Dict[str, Any]]" = OrderedDict([
     ("metrics_on", dict(model="trivial", batch_size=4,
                         metrics_port=9309,
                         run_store_dir="run_store")),
+    # ISSUE 17 (round 20): the GSPMD twin lattice. Each entry is an
+    # existing sharded golden's config plus --partitioner=gspmd: the
+    # SAME per-replica step function lowered under plain jit with
+    # NamedSharding-annotated state on the same ('batch', 'model')
+    # mesh, letting the XLA SPMD partitioner insert the collectives
+    # the manual shard_map programs write by hand (train_step.py
+    # _gspmd_wrap). The twin referee (audit.rule_partitioner_twin)
+    # traces each one's manual twin (config minus the flag), diffs
+    # collective inventory + largest live buffer, and classifies the
+    # divergence -- only the "bug" class violates; the full verdict
+    # rides the report for PERF.md's inventory-diff table. Losses are
+    # bit-identical between the twins (tests/test_partitioner.py).
+    ("gspmd_sharded_base", dict(model="trivial", batch_size=4,
+                                optimizer="momentum",
+                                shard_optimizer_state=True,
+                                partitioner="gspmd")),
+    ("gspmd_fsdp_base", dict(model="trivial", batch_size=4,
+                             optimizer="momentum",
+                             shard_optimizer_state=True,
+                             shard_params=True,
+                             partitioner="gspmd")),
+    ("gspmd_lm_sharded", dict(model="transformer_lm", batch_size=8,
+                              optimizer="momentum",
+                              shard_optimizer_state=True,
+                              partitioner="gspmd")),
+    # The accum twin: the once-per-step gradient exchange must stay
+    # OUT of the microbatch scan on the gspmd side too -- the
+    # referee's in-loop-gradient bug leg binds here (and the mutation
+    # self-test seeds exactly that regression).
+    ("gspmd_accum", dict(model="trivial", batch_size=4,
+                         optimizer="momentum",
+                         shard_optimizer_state=True,
+                         num_grad_accum=2,
+                         partitioner="gspmd")),
 ])
 
 
@@ -528,6 +562,15 @@ SERVING_GOLDEN_CONFIGS: "OrderedDict[str, Dict[str, Any]]" = OrderedDict([
     ("serving_verify", dict(bucket=4, speculative_k=4,
                             draft_n_layers=2,
                             program="serving_verify")),
+    # ISSUE 17 (round 20): the tensor-parallel decode twin -- the same
+    # bucket-4 decode step lowered with Megatron-style NamedShardings
+    # over a 2-device ('model',) mesh (decode.tp_shardings: KV cache
+    # sharded on the head axis, attention/MLP kernels column/row-
+    # parallel) and GSPMD inserting the block reductions. The twin
+    # referee (audit.rule_partitioner_twin) diffs it against
+    # serving_decode's program and classifies; the compiled HLO is the
+    # per-partition module, so buffer bounds here are per-shard.
+    ("serving_decode_tp", dict(bucket=4, model_shards=2)),
 ])
 
 
@@ -567,7 +610,8 @@ def trace_serving_contract(overrides: Dict[str, Any],
     fn, args, donate = decode_lib.verify_lowering_args(spec, bucket)
   else:
     fn, args, donate = decode_lib.decode_lowering_args(spec, bucket)
-  compiled = jax.jit(fn, donate_argnums=donate).lower(*args).compile()
+  compiled = decode_lib.aot_jit(spec, fn, program, bucket,
+                                donate).lower(*args).compile()
   itemsize = jnp.dtype(spec.dtype).itemsize
   aux: Dict[str, Any] = {
       "bucket_ladder": list(engine_lib.DEFAULT_BUCKET_LADDER),

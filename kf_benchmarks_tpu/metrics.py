@@ -296,6 +296,12 @@ _info("git_rev", "Git revision the run was built from", "bench")
 _info("platform", "Execution platform (tpu | cpu)", "bench")
 _info("metric", "Headline metric name", "bench")
 _info("unit", "Headline metric unit", "bench")
+# Round 20: which partitioner shaped the sharded step's collectives --
+# "manual" (hand-written shard_map programs) or "gspmd" (plain jit +
+# NamedShardings, XLA SPMD chooses the exchange). Provenance on the
+# JSON line; the flag itself is program-shaping and keys the record's
+# config fingerprint.
+_info("partitioner", "Collective partitioner (manual | gspmd)", "bench")
 # Tuned-config provenance (--autotuned_config, analysis/autotune.py):
 # flatten_stats expands the nested stats/bench-JSON payload onto these,
 # so the run-store snapshot records WHICH table row shaped a run (the

@@ -548,8 +548,13 @@ class TransformerLMModel(model_lib.Model):
       block_template = jax.tree.map(
           lambda s: jax.ShapeDtypeStruct(tuple(s.shape)[1:], s.dtype),
           variables["params"]["blocks"])
+      # --partitioner=gspmd traces the step under double vmap, which
+      # has no tuple-axis all_gather batching rule (jax 0.4.x): the
+      # hook's forward gather decomposes per axis there (element-
+      # identical; ops/sharded.combined_all_gather).
       fsdp_block_hook = overlap_lib.fsdp_block_gatherer(
-          block_template, BATCH_AXIS, MODEL_AXIS)
+          block_template, BATCH_AXIS, MODEL_AXIS,
+          nested=getattr(p, "partitioner", None) == "gspmd")
       self.fsdp_gathered_prefixes = ("blocks",)
     tiling = (dict(attn_block=attn_block, attn_q_block=attn_block)
               if attn_block else {})

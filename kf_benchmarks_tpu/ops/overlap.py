@@ -245,11 +245,15 @@ def wrap_tree(tree, axis_name, bucket_bytes: int, compact_dtype=None,
 class FsdpGatherSpec(NamedTuple):
   """Static (hashable) half of a gather bucket: full leaf shapes in
   bucket order plus the mesh axes. The shard half is the runtime
-  argument."""
+  argument. ``nested`` selects the vmap-safe decomposed forward gather
+  (ops/sharded.combined_all_gather) for the --partitioner=gspmd twin;
+  the default single tuple-axis collective is the manual-path form the
+  goldens pin."""
   batch_axis: str
   model_axis: str
   shapes: Tuple[Tuple[int, ...], ...]
   dtypes: Tuple[str, ...]
+  nested: bool = False
 
 
 def _fsdp_mesh(spec):
@@ -277,15 +281,24 @@ def gather_params(spec: FsdpGatherSpec, shards):
 # parallel/transformer._fsdp_block_hook) build on these, so the row
 # addressing and pad handling cannot drift between the two legs.
 
-def packed_gather_rows(axes, shapes, dtypes, shards):
+def packed_gather_rows(axes, shapes, dtypes, shards, nested=False):
   """Tuple of flat local (k_i,) shards -> tuple of FULL leaves via ONE
   tiled all-gather over ``axes``: concat the shards, gather, split the
   (n, K) row matrix back per leaf (row-major device order over the
-  axes tuple matches the flat shard index)."""
+  axes tuple matches the flat shard index). ``nested`` decomposes the
+  tuple-axis gather into per-axis gathers (innermost first -- same
+  row-major order) for the gspmd twin, whose double-vmap trace has no
+  tuple-axis all_gather batching rule in jax 0.4.x."""
   n = math.prod(lax.axis_size(a) for a in axes)
   ks = tuple(int(s.shape[0]) for s in shards)
   vec = jnp.concatenate(list(shards)) if len(shards) > 1 else shards[0]
-  mat = lax.all_gather(vec, axes, tiled=True).reshape(n, sum(ks))
+  if nested:
+    full = vec
+    for a in reversed(axes):
+      full = lax.all_gather(full, a, tiled=True)
+    mat = full.reshape(n, sum(ks))
+  else:
+    mat = lax.all_gather(vec, axes, tiled=True).reshape(n, sum(ks))
   outs, off = [], 0
   for k, shape, dtype in zip(ks, shapes, dtypes):
     size = int(math.prod(shape)) if shape else 1
@@ -322,7 +335,8 @@ def split_shard_row(row, ks, dtypes):
 
 def _gather_fwd_impl(spec, shards):
   return packed_gather_rows((spec.batch_axis, spec.model_axis),
-                            spec.shapes, spec.dtypes, shards)
+                            spec.shapes, spec.dtypes, shards,
+                            nested=spec.nested)
 
 
 def _gather_params_fwd(spec, shards):
@@ -384,7 +398,8 @@ def fsdp_plan_buckets(template, bucket_bytes: int,
 
 def fsdp_wrap_shards(shard_tree, template, bucket_bytes: int,
                      batch_axis, model_axis,
-                     exclude_prefixes: Tuple[str, ...] = ()):
+                     exclude_prefixes: Tuple[str, ...] = (),
+                     nested: bool = False):
   """Shard-layout param tree -> the tree the loss consumes: every
   non-excluded leaf replaced by its gathered FULL value (one
   :func:`gather_params` per builder-layer bucket), excluded
@@ -404,14 +419,16 @@ def fsdp_wrap_shards(shard_tree, template, bucket_bytes: int,
     spec = FsdpGatherSpec(
         batch_axis=batch_axis, model_axis=model_axis,
         shapes=tuple(tuple(t_leaves[i].shape) for i in bucket),
-        dtypes=tuple(jnp.dtype(t_leaves[i].dtype).name for i in bucket))
+        dtypes=tuple(jnp.dtype(t_leaves[i].dtype).name for i in bucket),
+        nested=nested)
     full = gather_params(spec, tuple(leaves[i] for i in bucket))
     for i, leaf in zip(bucket, full):
       out[i] = leaf
   return jax.tree_util.tree_unflatten(treedef, out)
 
 
-def fsdp_block_gatherer(block_template, batch_axis, model_axis):
+def fsdp_block_gatherer(block_template, batch_axis, model_axis,
+                        nested: bool = False):
   """Per-scanned-block gather hook (``nn.map_variables(...,
   trans_in_fn=hook, init=True)`` under nn.scan, or applied to the
   sliced xs at the top of a lax.scan body): stored per-block flat
@@ -429,7 +446,8 @@ def fsdp_block_gatherer(block_template, batch_axis, model_axis):
   spec = FsdpGatherSpec(
       batch_axis=batch_axis, model_axis=model_axis,
       shapes=tuple(tuple(t.shape) for t in t_leaves),
-      dtypes=tuple(jnp.dtype(t.dtype).name for t in t_leaves))
+      dtypes=tuple(jnp.dtype(t.dtype).name for t in t_leaves),
+      nested=nested)
 
   def hook(stored):
     leaves, treedef = jax.tree_util.tree_flatten(stored)

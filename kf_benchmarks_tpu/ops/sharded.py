@@ -102,15 +102,36 @@ def local_shards(tree, batch_axis: str = BATCH_AXIS,
   return jax.tree.map(f, tree)
 
 
+def combined_all_gather(x, batch_axis: str = BATCH_AXIS,
+                        model_axis: str = MODEL_AXIS, axis: int = 0,
+                        nested: bool = False):
+  """Tiled all-gather over the combined ``(batch, model)`` axes.
+
+  ``nested=False`` is the manual-path form: ONE collective over the
+  axes tuple (every existing golden contract pins this inventory).
+  ``nested=True`` decomposes it into model-then-batch single-axis
+  tiled gathers -- element-identical (inner gather tiles the model
+  peers, outer gather tiles the batch groups, reproducing the
+  row-major ``b * M + m`` concatenation order exactly) but required on
+  the --partitioner=gspmd path: jax 0.4.x has no vmap batching rule
+  for a tuple-axis all_gather, and the gspmd twin traces the step body
+  under double ``jax.vmap`` (train_step.py)."""
+  if not nested:
+    return lax.all_gather(x, (batch_axis, model_axis), axis=axis,
+                          tiled=True)
+  inner = lax.all_gather(x, model_axis, axis=axis, tiled=True)
+  return lax.all_gather(inner, batch_axis, axis=axis, tiled=True)
+
+
 def gather_tree(shards, template, batch_axis: str = BATCH_AXIS,
-                model_axis: str = MODEL_AXIS):
+                model_axis: str = MODEL_AXIS, nested: bool = False):
   """Flat shard tree -> full tree: tiled all-gather over the combined
   ``(batch, model)`` axes (row-major concatenation matches the
-  scatter/slice block order), drop the pad, restore leaf shapes."""
-  axes = (batch_axis, model_axis)
-
+  scatter/slice block order), drop the pad, restore leaf shapes.
+  ``nested`` selects the vmap-safe decomposed gather (see
+  :func:`combined_all_gather`) for the gspmd twin."""
   def f(s, t):
-    full = lax.all_gather(s, axes, tiled=True)
+    full = combined_all_gather(s, batch_axis, model_axis, nested=nested)
     return full[:t.size].reshape(t.shape).astype(t.dtype)
   return jax.tree.map(f, shards, template)
 
@@ -181,7 +202,7 @@ def fsdp_stacked_shards(tree, num_shards: int, scanned_prefixes=()):
 
 def fsdp_gather_full(local, template, scanned_prefixes=(),
                      batch_axis: str = BATCH_AXIS,
-                     model_axis: str = MODEL_AXIS):
+                     model_axis: str = MODEL_AXIS, nested: bool = False):
   """Local FSDP shard tree (leaves (k,) / (L, k), i.e. the squeezed
   per-device rows) -> the FULL tree, inside the shard_mapped body.
 
@@ -189,16 +210,16 @@ def fsdp_gather_full(local, template, scanned_prefixes=(),
   path use it (the accumulated-gradient path keeps the full tree
   resident for the microbatch scan, exactly like the round-11 steady
   state -- the in-compute per-bucket gathers disengage there the same
-  way the overlap hooks do)."""
-  axes = (batch_axis, model_axis)
-
+  way the overlap hooks do). ``nested`` selects the vmap-safe
+  decomposed gather (:func:`combined_all_gather`) for the gspmd twin."""
   def plain(s, t):
-    full = lax.all_gather(s, axes, tiled=True)
+    full = combined_all_gather(s, batch_axis, model_axis, nested=nested)
     return full[:t.size].reshape(t.shape).astype(t.dtype)
 
   def scanned(s, t):
     size = int(np.prod(t.shape[1:], dtype=np.int64)) if t.ndim > 1 else 1
-    full = lax.all_gather(s, axes, axis=1, tiled=True)  # (L, n*k)
+    full = combined_all_gather(s, batch_axis, model_axis, axis=1,
+                               nested=nested)  # (L, n*k)
     return full[:, :size].reshape(t.shape).astype(t.dtype)
 
   by_path = dict(jax.tree_util.tree_flatten_with_path(template)[0])

@@ -230,6 +230,26 @@ flags.DEFINE_boolean("shard_params", False,
                      "--num_grad_accum the in-compute gathers "
                      "disengage (one whole-tree gather per step, like "
                      "the overlap hooks' accum rule).")
+flags.DEFINE_enum("partitioner", None, ("manual", "gspmd"),
+                  "Who places the collectives in the sharded training "
+                  "step. 'manual' (the None default) = the hand-placed "
+                  "shard_map programs (ops/sharded.py + ops/overlap.py; "
+                  "every golden contract pins them byte-identically). "
+                  "'gspmd' = the SAME step body lowered under plain "
+                  "jit with NamedSharding-annotated state/batch on the "
+                  "same ('batch', 'model') mesh, letting the XLA SPMD "
+                  "partitioner insert/re-place the collectives (Xu et "
+                  "al. 2021); losses stay bit-identical at f32 and the "
+                  "analysis/audit.py twin-referee rule classifies every "
+                  "inventory divergence. Sharded families "
+                  "(--shard_optimizer_state [+ --shard_params]) and "
+                  "serving only -- the gossip/async-PS/independent/"
+                  "staged/hierarchical modes are semantic hand "
+                  "placements (validation.py). Program-shaping: a "
+                  "tuned knob (analysis/baseline.TUNED_KNOBS), so "
+                  "gspmd runs never mix with manual run-store history. "
+                  "None default keeps non-sharded fingerprints "
+                  "untouched (fingerprints drop None fields).")
 flags.DEFINE_enum("variable_update", "replicated",
                   ("independent", "parameter_server", "replicated",
                    "distributed_replicated", "distributed_all_reduce",
@@ -589,6 +609,18 @@ flags.DEFINE_integer("serving_draft_layers", None,
                      "model's layer count). Only meaningful with "
                      "--serving_speculative_k (validation.py).",
                      lower_bound=1)
+flags.DEFINE_integer("serving_model_shards", None,
+                     "Tensor-parallel serving: shard the served LM's "
+                     "weights and KV cache over an M-way 'model' mesh "
+                     "axis (serving/decode.py model_shardings) and let "
+                     "GSPMD place the decode/prefill/verify "
+                     "collectives -- the serving leg of "
+                     "--partitioner=gspmd. Must divide the model's "
+                     "head count and the device count "
+                     "(validation.py). None = single-replica "
+                     "executables (fingerprints drop None fields, so "
+                     "existing serving history is untouched).",
+                     lower_bound=2)
 # Distributed / cluster flags (ref :570-583).
 flags.DEFINE_enum("job_name", "", ("ps", "worker", "controller", ""),
                   "Job role for multi-process runs (ref :571-573).")

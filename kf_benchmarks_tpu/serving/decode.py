@@ -96,6 +96,14 @@ class LMSpec:
   # k tokens per target verify dispatch.
   speculative_k: int = 0
   draft_n_layers: int = 0
+  # >=2: tensor-parallel serving (ISSUE 17) -- the decode/prefill/
+  # verify executables lower with Megatron-style NamedShardings over a
+  # ('model',)-axis mesh of this many devices (attention + MLP kernels
+  # column/row-parallel, KV cache sharded on the head axis, everything
+  # else replicated; tp_shardings below) and GSPMD inserts the
+  # exchange. 0 = single-device programs, byte-identical to before
+  # this round (config() emits None so fingerprints don't move).
+  model_shards: int = 0
 
   def __post_init__(self):
     if self.quantize not in (None, "int8"):
@@ -123,6 +131,23 @@ class LMSpec:
     if self.draft_n_layers and not self.speculative_k:
       raise ValueError(
           "draft_n_layers without speculative_k is inert -- set both")
+    if self.model_shards:
+      if self.model_shards < 2:
+        raise ValueError(
+            "model_shards must be >= 2 (1 is the unsharded program; "
+            "ask for 0 instead so fingerprints stay put)")
+      if self.n_heads % self.model_shards or \
+          self.d_ff % self.model_shards:
+        raise ValueError(
+            f"model_shards ({self.model_shards}) must divide n_heads "
+            f"({self.n_heads}) and d_ff ({self.d_ff}): the shardings "
+            "split the head and FF axes evenly")
+      if self.quantize:
+        raise ValueError(
+            "model_shards with quantize is not supported: the INT8 "
+            "per-out-channel scale leaves would need their own "
+            "resharding rules (untested composition; serve one of "
+            "the two)")
 
   @property
   def head_dim(self) -> int:
@@ -150,6 +175,7 @@ class LMSpec:
         "kv_page_size": self.kv_page_size or None,
         "speculative_k": self.speculative_k or None,
         "draft_n_layers": self.draft_n_layers or None,
+        "model_shards": self.model_shards or None,
     }
 
 
@@ -279,6 +305,112 @@ def _serving_view(spec: LMSpec, variables):
     return quantization.dequantize_variables(variables,
                                              spec.param_dtype)
   return variables
+
+
+# -- tensor-parallel shardings (ISSUE 17) -------------------------------------
+
+def serving_mesh(spec: LMSpec):
+  """The ('model',) tensor-parallel mesh over the first
+  ``spec.model_shards`` devices, or None when serving is unsharded."""
+  if not spec.model_shards:
+    return None
+  devices = jax.devices()
+  if len(devices) < spec.model_shards:
+    raise ValueError(
+        f"model_shards={spec.model_shards} needs that many devices; "
+        f"have {len(devices)}")
+  return jax.sharding.Mesh(np.array(devices[:spec.model_shards]),
+                           ("model",))
+
+
+def _variables_shardings(spec: LMSpec, mesh):
+  """Megatron-style NamedShardings for the serving param tree:
+  attention qkv and MLP-up kernels column-parallel (last dim),
+  attention-out and MLP-down row-parallel (contraction dim), their
+  column-parallel biases sharded with the columns, embeddings / LNs /
+  head replicated. GSPMD propagates these through the forward and
+  inserts one reduction per block where the row-parallel matmuls
+  meet -- the hand-derived TP exchange, without hand-writing it."""
+  P = jax.sharding.PartitionSpec
+  ns = lambda *axes: jax.sharding.NamedSharding(mesh, P(*axes))
+  col3 = ns(None, None, "model")   # (L, in, out): split out
+  row3 = ns(None, "model", None)   # (L, in, out): split in
+  by_name = {
+      "qkv": {"kernel": col3},
+      "mlp_up": {"kernel": col3, "bias": ns(None, "model")},
+      "attn_out": {"kernel": row3},
+      "mlp_down": {"kernel": row3},
+  }
+
+  def spec_for(path, leaf):
+    names = [str(getattr(k, "key", k)) for k in path]
+    for mod, fields in by_name.items():
+      if mod in names:
+        for field, sharding in fields.items():
+          if field in names:
+            return sharding
+    return ns()
+
+  return jax.tree_util.tree_map_with_path(spec_for,
+                                          abstract_variables(spec))
+
+
+def _kv_sharding(spec: LMSpec, mesh, head_axis: int, ndim: int):
+  """KV buffers shard on the head axis (dense ring (L, B, T, H, Dh)
+  and paged pool (L, P, page, H, Dh) both carry H at index 3; prefill
+  extracts at index 3 of (B_pack, L, T, H, Dh) too)."""
+  P = jax.sharding.PartitionSpec
+  axes = [None] * ndim
+  axes[head_axis] = "model"
+  return jax.sharding.NamedSharding(mesh, P(*axes))
+
+
+def tp_shardings(spec: LMSpec, program: str, bucket: int):
+  """(in_shardings, out_shardings) for one serving program's jit,
+  matching its lowering-args order exactly; (None, None) when the spec
+  is unsharded. The engine AND the auditor's serving tracer compile
+  through aot_jit below, so the sharded program the golden pins is the
+  one the engine caches."""
+  mesh = serving_mesh(spec)
+  if mesh is None:
+    return None, None
+  P = jax.sharding.PartitionSpec
+  rep = jax.sharding.NamedSharding(mesh, P())
+  var_sh = _variables_shardings(spec, mesh)
+  if program == "serving_verify":
+    return (var_sh, rep), rep
+  if program == "serving_prefill":
+    ekv = _kv_sharding(spec, mesh, 3, 5)
+    return (var_sh, rep, rep, rep, rep), (rep, ekv, ekv)
+  kv = _kv_sharding(spec, mesh, 3, 5)
+  if spec.kv_page_size:
+    ins = (var_sh, kv, kv, rep, rep, rep, rep)
+  else:
+    ins = (var_sh, kv, kv, rep, rep, rep)
+  return ins, (rep, kv, kv, rep)
+
+
+def aot_jit(spec: LMSpec, fn, program: str, bucket: int, donate):
+  """The ONE serving jit recipe: donation always, tensor-parallel
+  in/out NamedShardings when ``spec.model_shards`` (tp_shardings).
+  Shared by the engine's executable cache and the auditor's tracer."""
+  ins, outs = tp_shardings(spec, program, bucket)
+  if ins is None:
+    return jax.jit(fn, donate_argnums=donate)
+  return jax.jit(fn, in_shardings=ins, out_shardings=outs,
+                 donate_argnums=donate)
+
+
+def place_serving_args(spec: LMSpec, program: str, bucket: int, args):
+  """device_put concrete call args onto the program's compiled
+  shardings. AOT executables accept only exactly-placed arrays; the
+  engine's host loop hands back eager-op results (cache installs,
+  ladder gathers) whose placement GSPMD's propagation chose, so every
+  dispatch re-pins them (a no-op for already-matching arrays)."""
+  ins, _ = tp_shardings(spec, program, bucket)
+  if ins is None:
+    return args
+  return tuple(jax.device_put(a, s) for a, s in zip(args, ins))
 
 
 def kv_pool_pages(spec: LMSpec, bucket: int) -> int:

@@ -284,13 +284,15 @@ class ServingEngine:
   # -- executable cache (the bounded set) -------------------------------------
 
   def _compile(self, kind: str, bucket: int, fn, abstract_args,
-               donate) -> Any:
+               donate, spec=None) -> Any:
     from kf_benchmarks_tpu.analysis import baseline as baseline_lib
-    import jax
     key = baseline_lib.config_fingerprint_key(
         self.cfg.fingerprint_config(bucket, kind), program=kind)
     t0 = time.monotonic()
-    compiled = jax.jit(fn, donate_argnums=donate).lower(
+    # The shared AOT recipe (decode.aot_jit): donation always, and the
+    # tensor-parallel NamedShardings when spec.model_shards is set.
+    compiled = decode_lib.aot_jit(spec or self._step_spec, fn, kind,
+                                  bucket, donate).lower(
         *abstract_args).compile()
     tracing_lib.active().note_compile(key, kind,
                                       time.monotonic() - t0,
@@ -331,7 +333,8 @@ class ServingEngine:
       fn, args, donate = decode_lib.verify_lowering_args(self.spec,
                                                          bucket)
       self._verify_exes[bucket] = self._compile(
-          "serving_verify", bucket, fn, args, donate=donate)
+          "serving_verify", bucket, fn, args, donate=donate,
+          spec=self.spec)
     return self._verify_exes[bucket]
 
   def warm(self, buckets: Optional[Sequence[int]] = None) -> int:
@@ -553,9 +556,10 @@ class ServingEngine:
     trace = tracing_lib.active()
     with trace.span("serving", "prefill", requests=r,
                     bucket=pack_bucket):
-      first, ek, ev = exe(self._step_vars, jnp.asarray(packed_np),
-                          jnp.asarray(rows), jnp.asarray(last_pos),
-                          jnp.asarray(offsets))
+      first, ek, ev = exe(*decode_lib.place_serving_args(
+          self._step_spec, "serving_prefill", pack_bucket,
+          (self._step_vars, jnp.asarray(packed_np), jnp.asarray(rows),
+           jnp.asarray(last_pos), jnp.asarray(offsets))))
       if self._pps:
         self._cache = decode_lib.install_prefill_paged(
             self._cache, ek, ev, first, jnp.asarray(lengths),
@@ -597,14 +601,13 @@ class ServingEngine:
     exe = self._decode_exe(self._bucket)
     cache = self._cache
     if self._pps:
-      nxt, k, v, pos = exe(self._step_vars, cache.k, cache.v,
-                           cache.pos, cache.tok,
-                           jnp.asarray(self._table_np),
-                           jnp.asarray(active_np))
+      args = (self._step_vars, cache.k, cache.v, cache.pos, cache.tok,
+              jnp.asarray(self._table_np), jnp.asarray(active_np))
     else:
-      nxt, k, v, pos = exe(self._step_vars, cache.k, cache.v,
-                           cache.pos, cache.tok,
-                           jnp.asarray(active_np))
+      args = (self._step_vars, cache.k, cache.v, cache.pos, cache.tok,
+              jnp.asarray(active_np))
+    nxt, k, v, pos = exe(*decode_lib.place_serving_args(
+        self._step_spec, "serving_decode", self._bucket, args))
     nxt_np = np.asarray(nxt)  # value dependency = completion
     self._cache = decode_lib.CacheState(k=k, v=v, pos=pos,
                                         tok=jnp.asarray(nxt))
@@ -676,7 +679,9 @@ class ServingEngine:
     exe = self._verify_exe(bucket)
     with trace.span("serving", "verify", active=n_active,
                     bucket=bucket):
-      preds = np.asarray(exe(self.variables, jnp.asarray(rows_np)))
+      preds = np.asarray(exe(*decode_lib.place_serving_args(
+          self.spec, "serving_verify", bucket,
+          (self.variables, jnp.asarray(rows_np)))))
     now = self._time()
     self._spec_rounds += 1
     self._last_step_t = now

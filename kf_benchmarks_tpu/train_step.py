@@ -213,6 +213,24 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
         "--shard_params requires --shard_optimizer_state: the FSDP "
         "forward consumes the sharded family's scatter/apply machinery "
         "(ops/sharded.py); validation.py rejects the pair upstream")
+  # --partitioner: who places the collectives. 'manual' (default) keeps
+  # the exact legacy shard_map programs every golden contract pins;
+  # 'gspmd' lowers the SAME per-replica body under plain jit with
+  # NamedSharding-annotated state/batch and lets the XLA SPMD
+  # partitioner insert/re-place them (SNIPPETS [2]/[3] idiom; the
+  # analysis/audit.py twin-referee rule diffs the two inventories).
+  # Sharded families only: the replicated/gossip/PS strategies are
+  # hand-placed BY DESIGN (their collectives ARE the semantics --
+  # ppermute gossip, sequential PS apply); validation.py rejects the
+  # combinations upstream, this re-guards direct callers.
+  partitioner = getattr(params, "partitioner", None) or "manual"
+  use_gspmd = partitioner == "gspmd"
+  if use_gspmd and not sharded_state:
+    raise ValueError(
+        "--partitioner=gspmd covers the sharded training families "
+        "(--shard_optimizer_state [+ --shard_params]): the other "
+        "strategies' collectives are semantic hand placements, not "
+        "partitioning choices (validation.py rejects these upstream)")
   fsdp_template = None
   fsdp_module_prefixes = ()
   fsdp_bucket_bytes = 0
@@ -412,7 +430,8 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
       # round-11 steady state, rotated to the step top), full-tree
       # microbatch scan, post-hoc scatter below.
       forward_params = sharded_lib.fsdp_gather_full(
-          model_params, fsdp_template, fsdp_module_prefixes)
+          model_params, fsdp_template, fsdp_module_prefixes,
+          nested=use_gspmd)
     # Data-replica id: on the 2-D mesh, model-axis peers fold the SAME
     # id (same batch shard, same dropout stream), which is what makes
     # their local gradients identical by construction -- the free
@@ -454,7 +473,7 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
         # reason as the overlap hooks above.
         p = overlap_lib.fsdp_wrap_shards(
             p, fsdp_template, fsdp_bucket_bytes, BATCH_AXIS, MODEL_AXIS,
-            exclude_prefixes=fsdp_module_prefixes)
+            exclude_prefixes=fsdp_module_prefixes, nested=use_gspmd)
       variables = {"params": p}
       if bs:
         variables["batch_stats"] = bs
@@ -701,7 +720,8 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
                                            param_shards)
         new_shards = optax.apply_updates(param_shards, updates)
       new_params = (new_shards if sharded_params else
-                    sharded_lib.gather_tree(new_shards, model_params_pre))
+                    sharded_lib.gather_tree(new_shards, model_params_pre,
+                                            nested=use_gspmd))
     elif getattr(strategy, "sequential_apply", False):
       # Async PS with a stateful optimizer (strategies.py): serialize
       # every replica's unaveraged gradient through the SHARED optimizer
@@ -927,12 +947,89 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
   # models opt out via relax_shard_map_vma; everyone else keeps the
   # checker (it catches missing pmeans under out_specs=P()).
   check_vma = not getattr(model, "relax_shard_map_vma", False)
-  train_sharded = jax.shard_map(
-      per_replica_train, mesh=mesh,
-      in_specs=(state_specs, P(axis_data), P(axis_data)),
-      out_specs=(state_specs, P()), check_vma=check_vma)
 
-  train_step = jax.jit(train_sharded, donate_argnums=(0,))
+  # -- the gspmd twin (--partitioner=gspmd) ---------------------------------
+  #
+  # Same per-replica body, compiler-placed collectives: the body still
+  # speaks bound axis names (every lax.p* above), so instead of
+  # shard_map it is traced under two nested jax.vmap's -- outer
+  # 'batch', inner 'model' -- each binding axis_name AND
+  # spmd_axis_name over the (B, M)-regridded stacked state. The
+  # spmd_axis_name pins each vmap dimension to its mesh axis, the
+  # surrounding plain jit carries the SAME NamedShardings the manual
+  # path's specs induce, and GSPMD is then free to choose/re-place the
+  # collectives (the twin-referee rule in analysis/audit.py diffs the
+  # result against the hand placement). Batch inputs map on the outer
+  # vmap only (model peers see the same shard, exactly like in_specs
+  # P(axis_data)); scalars replicate in (in_axes=None) and come back
+  # broadcast (out_axes=0 everywhere -- the [0, 0] pick below avoids
+  # proving replication to vmap). eval_step and broadcast_init stay on
+  # the manual shard_map path in both modes: neither is on the
+  # steady-state hot path the twin A/B measures.
+  def _gspmd_wrap(per_fn, batch_dim):
+    grid_b = int(mesh.shape[BATCH_AXIS])
+    grid_m = int(mesh.shape[MODEL_AXIS])
+    stacked = ("params", "opt_state", "batch_stats", "buffers")
+    vmap_axes = TrainState(
+        step=None, params=0, opt_state=0, batch_stats=0, loss_scale=None,
+        loss_scale_normal_steps=None, rng=None, buffers=0)
+
+    def _map_stacked(state, f):
+      return state.replace(**{
+          name: jax.tree.map(f, getattr(state, name)) for name in stacked})
+
+    def tile(state, images, labels):
+      # The vmap's strip both grid dims; the body speaks the leading-1
+      # per-replica stacking convention.
+      new_state, metrics = per_fn(
+          _map_stacked(state, lambda x: x[None]), images, labels)
+      return _map_stacked(new_state,
+                          lambda x: jnp.squeeze(x, axis=0)), metrics
+
+    inner = jax.vmap(tile, in_axes=(vmap_axes, None, None),
+                     axis_name=MODEL_AXIS, spmd_axis_name=MODEL_AXIS)
+    outer = jax.vmap(inner, in_axes=(vmap_axes, batch_dim, batch_dim),
+                     axis_name=BATCH_AXIS, spmd_axis_name=BATCH_AXIS)
+
+    def global_fn(state, images, labels):
+      gridded = _map_stacked(
+          state,
+          lambda x: x.reshape((grid_b, grid_m) + x.shape[1:]))
+      split = lambda x: x.reshape(
+          x.shape[:batch_dim] +
+          (grid_b, x.shape[batch_dim] // grid_b) +
+          x.shape[batch_dim + 1:])
+      new_state, metrics = outer(gridded, split(images),
+                                 jax.tree.map(split, labels))
+      # Stacked leaves come back (B, M, ...) -> the flat (n, ...)
+      # stacking; replicated scalars/metrics come back broadcast over
+      # the grid -> any single copy (all bit-identical by SPMD).
+      pick = lambda x: x[0, 0]
+      out_state = _map_stacked(
+          new_state,
+          lambda x: x.reshape((grid_b * grid_m,) + x.shape[2:]))
+      out_state = out_state.replace(
+          step=pick(new_state.step), loss_scale=pick(new_state.loss_scale),
+          loss_scale_normal_steps=pick(new_state.loss_scale_normal_steps),
+          rng=pick(new_state.rng))
+      return out_state, jax.tree.map(pick, metrics)
+
+    data_spec = P(axis_data) if batch_dim == 0 else P(None, axis_data)
+    data_sharding = NamedSharding(mesh, data_spec)
+    return jax.jit(
+        global_fn,
+        in_shardings=(init_shardings, data_sharding, data_sharding),
+        out_shardings=(init_shardings, NamedSharding(mesh, P())),
+        donate_argnums=(0,))
+
+  if use_gspmd:
+    train_step = _gspmd_wrap(per_replica_train, 0)
+  else:
+    train_sharded = jax.shard_map(
+        per_replica_train, mesh=mesh,
+        in_specs=(state_specs, P(axis_data), P(axis_data)),
+        out_specs=(state_specs, P()), check_vma=check_vma)
+    train_step = jax.jit(train_sharded, donate_argnums=(0,))
 
   # -- chunked multi-step dispatch (--steps_per_dispatch) -------------------
 
@@ -956,12 +1053,15 @@ def make_step_fns(model, module, eval_module, strategy, tx, lr_fn, params,
 
   train_chunk = None
   if steps_per_dispatch > 1:
-    chunk_sharded = jax.shard_map(
-        per_replica_train_chunk, mesh=mesh,
-        in_specs=(state_specs, P(None, axis_data),
-                  P(None, axis_data)),
-        out_specs=(state_specs, P()), check_vma=check_vma)
-    train_chunk = jax.jit(chunk_sharded, donate_argnums=(0,))
+    if use_gspmd:
+      train_chunk = _gspmd_wrap(per_replica_train_chunk, 1)
+    else:
+      chunk_sharded = jax.shard_map(
+          per_replica_train_chunk, mesh=mesh,
+          in_specs=(state_specs, P(None, axis_data),
+                    P(None, axis_data)),
+          out_specs=(state_specs, P()), check_vma=check_vma)
+      train_chunk = jax.jit(chunk_sharded, donate_argnums=(0,))
 
   # -- forward-only / eval step --------------------------------------------
 

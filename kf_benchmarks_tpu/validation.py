@@ -488,6 +488,76 @@ def validate_cross_flags(params) -> None:
           "flat shards -- the histograms would silently describe one "
           "shard. Use verbosity 1 (scalars) or drop --shard_params "
           "for histogram debugging")
+  if getattr(p, "partitioner", None) == "gspmd":
+    # --partitioner=gspmd cross-flag matrix. The compiler-partitioned
+    # twin (train_step.py) covers programs whose collectives are
+    # PARTITIONING choices -- the sharded training families
+    # (--shard_optimizer_state [+ --shard_params]) and the serving
+    # decode leg (--serving_model_shards). Modes whose collectives ARE
+    # the semantics stay manual-only and are rejected here with the
+    # reason; note most also fall out of the sharded matrix above, but
+    # a bare --partitioner=gspmd with one of them set deserves the
+    # specific message, not the generic requires-sharded one.
+    if p.staged_vars:
+      raise ParamError(
+          "--partitioner=gspmd cannot be combined with --staged_vars: "
+          "the staging double-buffer is a hand-placed staleness "
+          "pattern (variable_mgr.py:246-274), not a partitioning "
+          "choice -- there is nothing for GSPMD to re-place")
+    if p.variable_update == "independent":
+      raise ParamError(
+          "--partitioner=gspmd cannot be combined with "
+          "--variable_update=independent: independent replicas run NO "
+          "collectives at all; a partitioner twin would have an empty "
+          "inventory to referee")
+    if p.variable_update == "kungfu" and p.kungfu_option != "sync_sgd":
+      raise ParamError(
+          "--partitioner=gspmd cannot be combined with the gossip "
+          f"modes (--kungfu_option={p.kungfu_option}): pair-averaging "
+          "ppermutes and SMA weight pmeans are semantic hand "
+          "placements (parallel/strategies.py), not compiler-"
+          "placeable data movement")
+    if (p.variable_update == "parameter_server"
+        and not p.cross_replica_sync):
+      raise ParamError(
+          "--partitioner=gspmd cannot be combined with async "
+          "parameter_server (--cross_replica_sync=false): the "
+          "sequential-apply scan consumes per-replica UNAVERAGED "
+          "gradients in replica order -- the collective order IS the "
+          "semantics there")
+    if p.hierarchical_copy or p.all_reduce_spec:
+      raise ParamError(
+          "--partitioner=gspmd cannot be combined with "
+          "--hierarchical_copy/--all_reduce_spec: the hierarchical/"
+          "spec'd reducers hand-pick the reduction algorithm (ref: "
+          "batch_allreduce.py:300-317), which is exactly the choice "
+          "gspmd delegates to the compiler")
+    if not bool(getattr(p, "shard_optimizer_state", False)) and \
+        not getattr(p, "serving_model_shards", None):
+      raise ParamError(
+          "--partitioner=gspmd covers the sharded training families "
+          "(--shard_optimizer_state [+ --shard_params]) and the "
+          "tensor-parallel serving leg (--serving_model_shards): the "
+          "replicated 1-D program has no NamedSharding-annotated "
+          "state for GSPMD to partition (train_step.py)")
+  shards_tp = getattr(p, "serving_model_shards", None)
+  if shards_tp:
+    # Tensor-parallel serving (serving/decode.py model_shardings): the
+    # head axis of the attention KV cache and the sharded weight
+    # matrices split M ways, so M must divide both the head count and
+    # the device pool the serving mesh draws from.
+    from kf_benchmarks_tpu.models import transformer_lm as _lm
+    if _lm.N_HEADS % shards_tp:
+      raise ParamError(
+          f"--serving_model_shards={shards_tp} must divide the served "
+          f"LM's head count ({_lm.N_HEADS}): the KV cache and "
+          "attention projections shard on the head axis "
+          "(serving/decode.py model_shardings)")
+    if p.num_devices % shards_tp:
+      raise ParamError(
+          f"--serving_model_shards={shards_tp} must divide "
+          f"--num_devices={p.num_devices}: the serving 'model' mesh "
+          "draws whole devices")
   if getattr(p, "fault_schedule", None):
     # Malformed schedules fail at startup, not at the named step: a
     # fault harness that silently skips its fault proves nothing.

@@ -56,6 +56,9 @@ _KNOB_CASES = {
     "input_prefetch_depth": (dict(BASE), 3),
     "attn_block": (dict(BASE, model="transformer_lm", batch_size=8),
                    256),
+    # The string-valued knob: gspmd only applies to the sharded
+    # families (cross-flag matrix), so the case rides a sharded base.
+    "partitioner": (dict(BASE, shard_optimizer_state=True), "gspmd"),
 }
 
 

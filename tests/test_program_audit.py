@@ -283,6 +283,24 @@ MUTATIONS = [
          slice(None), [x for x in c.collectives
                        if not (x.kind == "all-gather" and x.in_loop)]),
      "fsdp-residency"),
+    # ISSUE 17 seeds. The twin referee is the ONE owner of gspmd
+    # program shapes (every manual-shape rule stands down on
+    # partitioner=gspmd contracts): a gradient collective seeded into
+    # the microbatch scan on the gspmd side fires exactly the
+    # referee's in-loop bug leg -- accum-one-collective and
+    # overlap-in-backward are gspmd-guarded off, so nothing else may
+    # bite.
+    ("gspmd_in_loop_gradient_collective", "gspmd_accum",
+     lambda c: _add_collective(c, in_loop=True),
+     "partitioner-twin"),
+    # GSPMD re-materializing a buffer the manual program keeps
+    # sharded: the largest-live-buffer > 2x-manual bound is the
+    # referee's memory leg (the legitimate divergence classes stay
+    # inside 2x by construction on the goldens).
+    ("gspmd_buffer_blowup", "gspmd_sharded_base",
+     lambda c: setattr(c, "largest_tensor_bytes",
+                       c.largest_tensor_bytes * 20),
+     "partitioner-twin"),
 ]
 
 
