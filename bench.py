@@ -54,6 +54,11 @@ def main(argv=None):
   parser.add_argument("--serving_rate", type=float, default=None,
                       help="serving: offered load, requests/s "
                            "(default: platform-sized)")
+  parser.add_argument("--serving_tenants", type=int, default=None,
+                      help="serving: distinct tenants round-robined "
+                           "through the replay (default 1); >1 joins "
+                           "the workload fingerprint and lands "
+                           "per-tenant percentiles in the run store")
   parser.add_argument("--serving_bucket_ladder", default=None,
                       help="serving: --serving_bucket_ladder params "
                            "flag passthrough")
@@ -349,8 +354,12 @@ def run_serving_bench(args, on_tpu, attempts) -> int:
       tenant_tokens_per_s=p.serving_tenant_tokens_per_s)
   n_req = args.serving_requests or n_req
   rate = args.serving_rate or rate
+  n_tenants = max(1, args.serving_tenants or 1)
+  tenants = (tuple(f"tenant{i}" for i in range(n_tenants))
+             if n_tenants > 1 else ("default",))
   workload = poisson_workload(n_req, rate, spec, seed=0,
-                              max_new_tokens=cfg.max_new_tokens)
+                              max_new_tokens=cfg.max_new_tokens,
+                              tenants=tenants)
 
   # INT8 accuracy gate (ISSUE 16a): before serving a quantized spec,
   # measure prefix-conditioned greedy agreement vs the f32 weights on a
@@ -419,32 +428,54 @@ def run_serving_bench(args, on_tpu, attempts) -> int:
         "max_logit_delta": round(quantize_gate["max_logit_delta"], 6),
         "passed": quantize_gate["passed"]}
   # Every serving/* stat is a registered schema key; Nones (an empty
-  # replay) drop so the JSON line stays dense.
+  # replay) drop so the JSON line stays dense. The per-tenant block
+  # prunes the same way per tenant.
   record.update({k: (round(v, 6) if isinstance(v, float) else v)
-                 for k, v in stats.items() if v is not None})
+                 for k, v in stats.items()
+                 if v is not None and k != "serving_tenants"})
+  tenant_block = {
+      t: {k: (round(v, 6) if isinstance(v, float) else v)
+          for k, v in block.items() if v is not None}
+      for t, block in (stats.get("serving_tenants") or {}).items()}
+  if tenant_block:
+    record["serving_tenants"] = tenant_block
   record["git_rev"] = metrics_lib.git_revision()
   record["platform"] = "tpu" if on_tpu else "cpu"
   print(json.dumps(record), flush=True)
+  # Multi-tenant replays key apart from single-tenant history; the
+  # default (tenants=1) workload desc stays byte-identical to the
+  # pre-tenant fingerprint.
+  workload_desc = {"requests": n_req, "rate": rate}
+  if n_tenants > 1:
+    workload_desc["tenants"] = n_tenants
   fingerprint = baseline_lib.config_fingerprint_key(
       {**params._asdict(),
        "serving_spec": spec.config(),
-       "serving_workload": {"requests": n_req, "rate": rate}},
+       "serving_workload": workload_desc},
       "serving_bench")
   rc = record_and_check(record, on_tpu, args.run_store_dir,
                         args.check_regression, run_id=trace.run_id,
-                        fingerprint=fingerprint)
+                        fingerprint=fingerprint,
+                        extra_keys=("serving/ttft_p99",
+                                    "serving/shed_fraction"))
   tracing.deactivate()
   metrics_lib.deactivate()
   return rc
 
 
 def record_and_check(record, on_tpu, store_dir, check_regression,
-                     run_id=None, fingerprint=None) -> int:
+                     run_id=None, fingerprint=None,
+                     extra_keys=()) -> int:
   """Append this run's record to the run store; under
   --check-regression, judge it against the trailing same-fingerprint
   median and return the process exit code (nonzero = regression).
-  Split from main() so the sentinel leg is unit-testable on synthetic
-  records without running the benchmark."""
+  Every verdict reads its polarity from the metric schema
+  (metrics.metric_direction), so a lower-is-better headline (TTFT,
+  shed fraction) regresses on INCREASE; ``extra_keys`` adds snapshot
+  keys gated the same way, one verdict line each (the serving bench
+  gates TTFT p99 + shed fraction alongside tokens/s). Split from
+  main() so the sentinel leg is unit-testable on synthetic records
+  without running the benchmark."""
   from kf_benchmarks_tpu import metrics as metrics_lib
   from kf_benchmarks_tpu import tracing
   import jax
@@ -479,9 +510,19 @@ def record_and_check(record, on_tpu, store_dir, check_regression,
     return 0
   if not check_regression:
     return 0
-  verdict = metrics_lib.check_regression(history, rec)
+  verdict = metrics_lib.check_regression(
+      history, rec,
+      higher_is_better=metrics_lib.metric_direction(rec["metric"]))
   print(metrics_lib.verdict_line(verdict), file=sys.stderr, flush=True)
-  return 1 if verdict["status"] == "regression" else 0
+  rc = 1 if verdict["status"] == "regression" else 0
+  for key in extra_keys:
+    extra = metrics_lib.snapshot_check(history, rec, key)
+    if extra is None:
+      continue
+    print(metrics_lib.verdict_line(extra), file=sys.stderr, flush=True)
+    if extra["status"] == "regression":
+      rc = 1
+  return rc
 
 
 if __name__ == "__main__":

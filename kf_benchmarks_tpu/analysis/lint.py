@@ -583,8 +583,33 @@ def _registered_metric_keys(sources: List[_Source]):
   return keys, True
 
 
+# Publish methods whose labels= keyword names must come from the
+# schema's LABEL_NAMES tuple (the dimensional half of single-sourcing:
+# an emitter inventing a label name is the same hazard as inventing a
+# key -- the runtime check catches it live, this catches it in CI).
+_METRIC_PUBLISH_METHODS = {"set", "inc", "observe"}
+
+
+def _registered_label_names(sources: List[_Source]):
+  """The LABEL_NAMES tuple literal from metrics.py, parsed from the
+  AST (same stdlib-only discipline as _registered_metric_keys)."""
+  src = next((s for s in sources if s.path == _METRICS_HOME), None)
+  if src is None or src.tree is None:
+    return set()
+  for node in ast.walk(src.tree):
+    if (isinstance(node, ast.Assign) and len(node.targets) == 1
+        and isinstance(node.targets[0], ast.Name)
+        and node.targets[0].id == "LABEL_NAMES"
+        and isinstance(node.value, (ast.Tuple, ast.List))):
+      return {e.value for e in node.value.elts
+              if isinstance(e, ast.Constant)
+              and isinstance(e.value, str)}
+  return set()
+
+
 def rule_metric_key_literal(sources: List[_Source]) -> List[LintViolation]:
   keys, found_home = _registered_metric_keys(sources)
+  label_names = _registered_label_names(sources)
   out, hits = [], set()
   for src in sources:
     if not (src.path.startswith("kf_benchmarks_tpu/")
@@ -627,6 +652,21 @@ def rule_metric_key_literal(sources: List[_Source]) -> List[LintViolation]:
         if any(_is_metric_key_fragment(s) for s in sides):
           findings.append((node.lineno,
                            "metric key assembled by concatenation"))
+      elif (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _METRIC_PUBLISH_METHODS
+            and label_names):
+        for kw in node.keywords:
+          if kw.arg != "labels" or not isinstance(kw.value, ast.Dict):
+            continue
+          for k in kw.value.keys:
+            if (isinstance(k, ast.Constant)
+                and isinstance(k.value, str)
+                and k.value not in label_names):
+              findings.append((
+                  node.lineno,
+                  f"unregistered metric label name {k.value!r} "
+                  f"(LABEL_NAMES declares {sorted(label_names)})"))
     for lineno, what in findings:
       hits.add(src.path)
       if src.path in METRIC_KEY_ALLOWLIST:

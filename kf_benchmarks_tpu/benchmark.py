@@ -1028,6 +1028,7 @@ class BenchmarkCNN:
     trace.add_span("checkpoint", "save", t0, dur,
                    {"incarnation_bump": incarnation_bump})
     trace.add_sample("checkpoint_save", dur)
+    metrics_lib.active().observe("checkpoint_save_s", dur)
 
   def _verify_resumed_state(self, state) -> None:
     """Resume-time contract re-verification (analysis/audit.py): every
@@ -1475,6 +1476,7 @@ class BenchmarkCNN:
           tele.beat(done.chunk_interval)
       if done.chunk_end:
         trace.add_sample("chunk_wall", done.chunk_interval)
+        metrics_lib.active().observe("chunk_wall_s", done.chunk_interval)
         dispatch_span["id"] = None
       if noise_ema is not None and "noise_scale_g2" in m:
         noise_ema.update(float(m["noise_scale_g2"]),

@@ -170,6 +170,17 @@ def launch(np_: int, command: List[str], logdir: str = ".",
           for r in range(np_))
       print(f"kfrun: metrics endpoints: {targets}",
             file=sys.stderr, flush=True)
+      # Serving-mode children bind the engine's endpoint on the same
+      # port: point the operator at /healthz too, which carries the
+      # engine state AND the per-tenant SLO burn rates -- "up" vs "up
+      # but burning error budget" is the probe's whole point.
+      if any(tok == "--serving" or tok.startswith("--serving=")
+             for tok in command):
+        health = ", ".join(
+            f"http://127.0.0.1:{int(metrics_base) + r}/healthz"
+            for r in range(np_))
+        print("kfrun: serving healthz (engine + SLO burn state): "
+              f"{health}", file=sys.stderr, flush=True)
     for _ in range(max_restarts + 1):
       code, restart = _run_generation(server, gen_np, command, logdir,
                                       host, extra_env,

@@ -78,6 +78,13 @@ class EngineConfig:
   ttft_slo_s: Optional[float] = None  # default TTFT deadline (expiry)
   tenant_tokens_per_s: Optional[float] = None  # None = unmetered
   tenant_burst_s: float = 4.0        # token-bucket burst window
+  # Error-budget burn-rate monitoring (metrics.SLOMonitor): objectives
+  # are ttft_deadline (first token within its deadline) and
+  # shed_fraction (request admitted at all); target = good fraction.
+  slo_target: float = 0.99
+  slo_fast_window_s: float = 15.0
+  slo_slow_window_s: float = 60.0
+  slo_burn_threshold: float = 2.0
 
   def __post_init__(self):
     ladder = tuple(sorted(set(int(b) for b in self.bucket_ladder)))
@@ -129,7 +136,8 @@ class ServingEngine:
 
   def __init__(self, config: EngineConfig, variables=None,
                seed: int = 0, time_fn=time.monotonic,
-               sleep_fn=time.sleep, draft_variables=None):
+               sleep_fn=time.sleep, draft_variables=None,
+               recorder=None):
     self.cfg = config
     self.spec = config.spec
     self._time = time_fn
@@ -197,6 +205,26 @@ class ServingEngine:
     self._accept_lens: List[float] = []
     self._tenant_allowance: Dict[str, float] = {}
     self._tenant_last: Dict[str, float] = {}
+    # Per-tenant observability (round 21): every tenant the engine has
+    # seen gets its own TTFT/token-latency samples, token counts, and
+    # shed-by-reason counters -- the labeled half of the serving/*
+    # schema keys.
+    self._tenant_ttfts: Dict[str, List[float]] = {}
+    self._tenant_token_lat: Dict[str, List[float]] = {}
+    self._tenant_tokens: Dict[str, int] = {}
+    self._tenant_arrivals: Dict[str, int] = {}
+    self._tenant_completed: Dict[str, int] = {}
+    self._tenant_shed: Dict[Tuple[str, str], int] = {}
+    # Burn-rate monitor over the two serving objectives; alert
+    # episodes land on the flight recorder (when attached) and on
+    # /healthz -- data, never exceptions, like the sheds themselves.
+    self.slo = metrics_lib.SLOMonitor(
+        objectives={"ttft_deadline": config.slo_target,
+                    "shed_fraction": config.slo_target},
+        fast_window_s=config.slo_fast_window_s,
+        slow_window_s=config.slo_slow_window_s,
+        burn_threshold=config.slo_burn_threshold,
+        time_fn=time_fn, recorder=recorder)
     self._t_serve0: Optional[float] = None
     self._t_serve1: Optional[float] = None
     self._last_step_t: Optional[float] = None
@@ -215,8 +243,12 @@ class ServingEngine:
     if req.enqueue_t is None:
       req.enqueue_t = now
     self._arrivals += 1
+    tenant = req.tenant
+    self._tenant_arrivals[tenant] = \
+        self._tenant_arrivals.get(tenant, 0) + 1
     reg = metrics_lib.active()
     reg.inc("serving/requests")
+    reg.inc("serving/requests", labels={"tenant": tenant})
     if len(self._queue) >= self.cfg.max_queue_depth:
       self._shed_request(req, "queue_depth")
       return False
@@ -269,12 +301,60 @@ class ServingEngine:
   def _shed_request(self, req: Request, reason: str,
                     status: str = "rejected") -> None:
     self._shed += 1
+    tenant = req.tenant
+    self._tenant_shed[(tenant, reason)] = \
+        self._tenant_shed.get((tenant, reason), 0) + 1
     reg = metrics_lib.active()
     reg.inc("serving/shed")
+    reg.inc("serving/shed", labels={"tenant": tenant,
+                                    "shed_reason": reason})
+    # A shed is a bad event on the shed-fraction objective; it also
+    # burns the TTFT objective when the request carried a deadline (it
+    # will never see a first token).
+    self.slo.observe("shed_fraction", tenant, good=False)
+    if self._deadline(req) is not None:
+      self.slo.observe("ttft_deadline", tenant, good=False)
+    self._publish_slo(tenant)
     tracing_lib.active().instant("serving", "shed", rid=str(req.rid),
                                  reason=reason)
     self._record(RequestResult(rid=req.rid, tenant=req.tenant,
                                status=status, shed_reason=reason))
+
+  _SLO_BURN_KEYS = {
+      "ttft_deadline": ("serving/slo_ttft_burn_fast",
+                        "serving/slo_ttft_burn_slow"),
+      "shed_fraction": ("serving/slo_shed_burn_fast",
+                        "serving/slo_shed_burn_slow"),
+  }
+
+  def _publish_slo(self, tenant: str) -> None:
+    """Publish this tenant's current burn rates as labeled gauges (the
+    live half; stats() republishes the final values at drain)."""
+    reg = metrics_lib.active()
+    for objective, (fast_key, slow_key) in self._SLO_BURN_KEYS.items():
+      burns = self.slo.burn(objective, tenant)
+      if burns["fast"] is not None:
+        reg.set(fast_key, burns["fast"], labels={"tenant": tenant})
+      if burns["slow"] is not None:
+        reg.set(slow_key, burns["slow"], labels={"tenant": tenant})
+
+  def _note_first_token(self, req: Request, now: float) -> float:
+    """First-token bookkeeping shared by the plain prefill path and
+    the first speculative verify round: global + per-tenant TTFT
+    samples, the labeled TTFT histogram, and the ttft_deadline SLO
+    event (good iff the first token beat the request's deadline)."""
+    ttft = now - req.enqueue_t
+    tenant = req.tenant
+    self._ttfts.append(ttft)
+    self._tenant_ttfts.setdefault(tenant, []).append(ttft)
+    tracing_lib.active().add_sample("serving/ttft", ttft)
+    metrics_lib.active().observe("serving/ttft_s", ttft,
+                                 labels={"tenant": tenant})
+    deadline = self._deadline(req)
+    if deadline is not None:
+      self.slo.observe("ttft_deadline", tenant, good=ttft <= deadline)
+      self._publish_slo(tenant)
+    return ttft
 
   def _record(self, result: RequestResult) -> None:
     if result.rid not in self._results:
@@ -579,9 +659,7 @@ class ServingEngine:
                 "props": [int(first_np[i])],
                 "t_first": None, "ttft": None}
       else:
-        ttft = now - req.enqueue_t
-        self._ttfts.append(ttft)
-        trace.add_sample("serving/ttft", ttft)
+        ttft = self._note_first_token(req, now)
         slot = {"req": req, "tokens": [int(first_np[i])],
                 "t_first": now, "ttft": ttft}
       if self._pps:
@@ -612,7 +690,9 @@ class ServingEngine:
     self._cache = decode_lib.CacheState(k=k, v=v, pos=pos,
                                         tok=jnp.asarray(nxt))
     self._decode_steps += 1
-    metrics_lib.active().inc("serving/decode_steps")
+    reg = metrics_lib.active()
+    reg.inc("serving/decode_steps")
+    reg.inc("serving/decode_steps", labels={"bucket": str(self._bucket)})
     return nxt_np
 
   def _decode_step(self) -> None:
@@ -632,6 +712,7 @@ class ServingEngine:
     trace.add_sample("serving/token_latency", step_wall)
     self._token_lat.append(step_wall)
     reg = metrics_lib.active()
+    reg.observe("serving/token_latency_s", step_wall)
     reg.set("serving/active", n_active)
     for i, slot in enumerate(self._slots):
       if slot is None:
@@ -713,6 +794,7 @@ class ServingEngine:
       round_accepted += min(a, len(emit))
       self._accept_lens.append(float(min(a, len(emit))))
       trace.add_sample("serving/accept_len", float(min(a, len(emit))))
+      reg.observe("serving/accept_len", float(min(a, len(emit))))
       slot["tokens"].extend(emit)
       slot["history"] = np.concatenate(
           [history, np.asarray(emit, np.int32)])
@@ -724,10 +806,8 @@ class ServingEngine:
       new_pos[i] = slot["history"].size - 1
       new_tok[i] = emit[-1]
       if slot["t_first"] is None:
-        ttft = now - slot["req"].enqueue_t
-        slot["t_first"], slot["ttft"] = now, ttft
-        self._ttfts.append(ttft)
-        trace.add_sample("serving/ttft", ttft)
+        slot["t_first"] = now
+        slot["ttft"] = self._note_first_token(slot["req"], now)
       emitted_total += len(emit)
       if len(slot["tokens"]) >= self._max_new(slot["req"]):
         self._complete(i, now)
@@ -742,6 +822,7 @@ class ServingEngine:
     per_tok = (now - t0) / max(emitted_total, 1)
     self._token_lat.append(per_tok)
     trace.add_sample("serving/token_latency", per_tok)
+    reg.observe("serving/token_latency_s", per_tok)
 
   def _complete(self, slot_idx: int, now: float) -> None:
     slot = self._slots[slot_idx]
@@ -753,12 +834,32 @@ class ServingEngine:
       self._free_pages.extend(slot["pages"])
       self._table_np[slot_idx, :] = 0
     req = slot["req"]
+    tenant = req.tenant
     self._completed += 1
-    metrics_lib.active().inc("serving/completed")
+    self._tenant_completed[tenant] = \
+        self._tenant_completed.get(tenant, 0) + 1
+    self._tenant_tokens[tenant] = \
+        self._tenant_tokens.get(tenant, 0) + len(slot["tokens"])
+    reg = metrics_lib.active()
+    reg.inc("serving/completed")
+    reg.inc("serving/completed", labels={"tenant": tenant})
     result = RequestResult(
         rid=req.rid, tenant=req.tenant, status="ok",
         tokens=list(slot["tokens"]), ttft_s=slot["ttft"],
         total_s=now - req.enqueue_t)
+    # Per-tenant token latency: the request's own mean decode interval
+    # (total wall after the first token over the tokens it bought) --
+    # a per-REQUEST figure, so a tenant's percentiles reflect its own
+    # requests rather than whichever batch it shared.
+    if len(result.tokens) > 1 and result.ttft_s is not None:
+      per_tok = (result.total_s - result.ttft_s) / (len(result.tokens)
+                                                    - 1)
+      self._tenant_token_lat.setdefault(tenant, []).append(per_tok)
+      reg.observe("serving/token_latency_s", per_tok,
+                  labels={"tenant": tenant})
+    # A completion is a good event on the shed-fraction objective.
+    self.slo.observe("shed_fraction", tenant, good=True)
+    self._publish_slo(tenant)
     self._record(result)
     trace = tracing_lib.active()
     # Retrospective whole-request span: enqueue -> completion, on the
@@ -839,9 +940,12 @@ class ServingEngine:
 
   def healthz(self) -> Dict[str, Any]:
     """Engine liveness for the /healthz endpoint (metrics.py
-    MetricsServer healthz_fn)."""
+    MetricsServer healthz_fn). Status distinguishes "up" from "up but
+    burning error budget": any firing SLO stream turns it
+    "burning"."""
+    slo = self.slo.state()
     return {
-        "status": "ok",
+        "status": slo["status"] if slo["status"] != "ok" else "ok",
         "serving": {
             "state": self.state,
             "active": self._active_count(),
@@ -851,6 +955,7 @@ class ServingEngine:
             "shed": self._shed,
             "decode_steps": self._decode_steps,
         },
+        "slo": slo,
     }
 
   def serve_metrics(self, port: int, registry=None,
@@ -910,17 +1015,77 @@ class ServingEngine:
         "serving/accept_len_p99": (
             pct(self._accept_lens, 99)
             if self.spec.speculative_k else None),
+        "serving/slo_alerts": float(len(self.slo.alerts)),
+        # Aggregate burn = the worst tenant (the number an unlabeled
+        # dashboard should alarm on); None before any SLO event.
+        "serving/slo_ttft_burn_fast": self._agg_burn("ttft_deadline",
+                                                     "fast"),
+        "serving/slo_ttft_burn_slow": self._agg_burn("ttft_deadline",
+                                                     "slow"),
+        "serving/slo_shed_burn_fast": self._agg_burn("shed_fraction",
+                                                     "fast"),
+        "serving/slo_shed_burn_slow": self._agg_burn("shed_fraction",
+                                                     "slow"),
+        # Per-tenant block: flatten_stats expands it onto labeled keys
+        # (name{tenant=...}; sheds additionally carry shed_reason).
+        "serving_tenants": self.tenant_stats(),
     }
+    return out
+
+  def _agg_burn(self, objective: str, window: str) -> Optional[float]:
+    burns = [self.slo.burn(objective, t)[window]
+             for t in self._tenants_seen()]
+    burns = [b for b in burns if b is not None]
+    return max(burns) if burns else None
+
+  def _tenants_seen(self) -> List[str]:
+    seen = set(self._tenant_arrivals)
+    seen.update(t for (t, _r) in self._tenant_shed)
+    return sorted(seen)
+
+  def tenant_stats(self) -> Dict[str, Dict[str, Any]]:
+    """Per-tenant stats keyed on FULL registered metric names (so the
+    flattened labeled keys stay inside the single-source schema)."""
+    pct = tracing_lib.percentile
+    wall = None
+    if self._t_serve0 is not None and self._t_serve1 is not None:
+      wall = max(self._t_serve1 - self._t_serve0, 1e-9)
+    out: Dict[str, Dict[str, Any]] = {}
+    for tenant in self._tenants_seen():
+      ttfts = self._tenant_ttfts.get(tenant, [])
+      lats = self._tenant_token_lat.get(tenant, [])
+      sheds = {reason: n for (t, reason), n in
+               sorted(self._tenant_shed.items()) if t == tenant}
+      ttft_burn = self.slo.burn("ttft_deadline", tenant)
+      shed_burn = self.slo.burn("shed_fraction", tenant)
+      out[tenant] = {
+          "serving/requests": self._tenant_arrivals.get(tenant, 0),
+          "serving/completed": self._tenant_completed.get(tenant, 0),
+          "serving/shed": sheds or None,
+          "serving/tokens_per_sec": (
+              self._tenant_tokens.get(tenant, 0) / wall
+              if wall else None),
+          "serving/ttft_p50": pct(ttfts, 50),
+          "serving/ttft_p90": pct(ttfts, 90),
+          "serving/ttft_p99": pct(ttfts, 99),
+          "serving/token_latency_p50": pct(lats, 50),
+          "serving/token_latency_p90": pct(lats, 90),
+          "serving/token_latency_p99": pct(lats, 99),
+          "serving/slo_ttft_burn_fast": ttft_burn["fast"],
+          "serving/slo_ttft_burn_slow": ttft_burn["slow"],
+          "serving/slo_shed_burn_fast": shed_burn["fast"],
+          "serving/slo_shed_burn_slow": shed_burn["slow"],
+      }
     return out
 
   def _publish(self) -> None:
     reg = metrics_lib.active()
-    for key, value in self.stats().items():
-      if value is None:
-        continue
-      if metrics_lib.SCHEMA[key].kind == "counter":
-        continue  # counters were incremented live
-      reg.set(key, value)
+    for key, value in metrics_lib.flatten_stats(self.stats()).items():
+      base, labels = metrics_lib.parse_labeled_key(key)
+      kind = metrics_lib.SCHEMA[base].kind
+      if kind in ("counter", "histogram"):
+        continue  # counters/histograms were published live
+      reg.set(base, value, labels=labels or None)
 
 
 # -- replayable workloads -----------------------------------------------------

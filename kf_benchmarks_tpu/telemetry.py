@@ -715,7 +715,14 @@ class TelemetrySession:
                      or 0.0),
         log_fn=log_fn, recorder=self.recorder)
     self.watchdog.start()
+    self._slo_monitor = None
     self._closed = False
+
+  def attach_slo(self, monitor) -> None:
+    """Attach a metrics.SLOMonitor so /healthz carries its burn state
+    (and its alert episodes already ride this session's recorder when
+    the monitor was built with ``recorder=session.recorder``)."""
+    self._slo_monitor = monitor
 
   def beat(self, wall_s: Optional[float] = None) -> None:
     self.watchdog.beat(wall_s)
@@ -756,6 +763,14 @@ class TelemetrySession:
     last = self.recorder.tail(1)
     if last:
       payload["last_step"] = last[0].get("step")
+    if self._slo_monitor is not None:
+      # "up" vs "up but burning error budget": a firing SLO stream
+      # upgrades an otherwise-ok status (a stall still wins -- a
+      # wedged dispatcher is the more urgent diagnosis).
+      slo = self._slo_monitor.state()
+      payload["slo"] = slo
+      if payload["status"] == "ok" and slo["status"] != "ok":
+        payload["status"] = slo["status"]
     return payload
 
   def close(self, reason: str = "run end") -> None:

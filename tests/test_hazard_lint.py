@@ -439,6 +439,35 @@ def test_metric_key_allowlist_staleness(tmp_path, monkeypatch):
   assert len(violations) == 1 and "stale" in violations[0].message
 
 
+# Dimensional half of the rule: label names on publish calls are
+# single-sourced in the schema's LABEL_NAMES tuple.
+LABELS_HOME = METRICS_HOME + "LABEL_NAMES = ('tenant', 'bucket')\n"
+
+
+def test_unregistered_label_name_seeded(tmp_path, monkeypatch):
+  monkeypatch.setattr(lint, "METRIC_KEY_ALLOWLIST", {})
+  _seed(tmp_path, "kf_benchmarks_tpu/metrics.py", LABELS_HOME)
+  _seed(tmp_path, "kf_benchmarks_tpu/rogue_labels.py",
+        "def f(reg):\n"
+        "  reg.inc('health/grad_norm', labels={'user': 't0'})\n")
+  violations = _rules(tmp_path, "metric-key-literal")
+  assert len(violations) == 1
+  assert "unregistered metric label name 'user'" in violations[0].message
+  assert "tenant" in violations[0].message  # names the declared set
+
+
+def test_registered_label_name_clean(tmp_path, monkeypatch):
+  monkeypatch.setattr(lint, "METRIC_KEY_ALLOWLIST", {})
+  _seed(tmp_path, "kf_benchmarks_tpu/metrics.py", LABELS_HOME)
+  # Declared names are clean; non-literal label dicts are the runtime
+  # check's business, not the lint's.
+  _seed(tmp_path, "kf_benchmarks_tpu/publisher.py",
+        "def f(reg, labs):\n"
+        "  reg.set('health/grad_norm', 1.0, labels={'tenant': 't0'})\n"
+        "  reg.observe('health/grad_norm', 0.1, labels=labs)\n")
+  assert not _rules(tmp_path, "metric-key-literal")
+
+
 # -- flag-validation ----------------------------------------------------------
 
 PARAMS = ("from kf_benchmarks_tpu import flags\n\n"

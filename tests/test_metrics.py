@@ -96,8 +96,14 @@ def test_prometheus_render_is_schema_valid():
   assert metrics.validate_prometheus_text(text) == []
   assert "kf_images_per_sec 123.456" in text
   assert "# TYPE kf_num_steps counter" in text
-  assert "# TYPE kf_feed_wait_s summary" in text
-  assert 'kf_feed_wait_s{quantile="0.50"} 0.025' in text
+  # Histogram-kind keys render as TRUE cumulative histograms (round
+  # 21): le-bucket counts monotone to +Inf == _count, sum preserved.
+  assert "# TYPE kf_feed_wait_s histogram" in text
+  assert 'kf_feed_wait_s_bucket{le="0.01"} 1' in text
+  assert 'kf_feed_wait_s_bucket{le="0.025"} 2' in text
+  assert 'kf_feed_wait_s_bucket{le="0.05"} 4' in text
+  assert 'kf_feed_wait_s_bucket{le="+Inf"} 4' in text
+  assert "kf_feed_wait_s_sum 0.1" in text
   assert "kf_feed_wait_s_count 4" in text
   # Info values collapse onto one labeled row, label-escaped.
   assert 'kf_run_info{run_id="run-\\"x\\"\\n"} 1' in text
@@ -113,13 +119,22 @@ def test_validate_prometheus_text_rejects_malformed():
       "kf_x 1\nkf_y{a=\"b\"} 2.5\nkf_z NaN\n") == []
 
 
-def test_histogram_decimation_keeps_true_count(monkeypatch):
-  monkeypatch.setattr(metrics, "_HIST_MAX_SAMPLES", 8)
+def test_histogram_bins_are_bounded_and_exact():
+  # Bucket-count storage (round 21): memory is fixed at
+  # len(bounds) + 1 bins regardless of observation volume, and count /
+  # sum stay exact (no decimation).
   reg = metrics.MetricRegistry()
-  for i in range(100):
-    reg.observe("feed_wait_s", float(i))
-  assert reg.snapshot()["feed_wait_s/count"] == 100
-  assert len(reg._hists["feed_wait_s"][2]) < 16
+  for i in range(1000):
+    reg.observe("feed_wait_s", float(i))  # most overflow to +Inf
+  snap = reg.snapshot()
+  assert snap["feed_wait_s/count"] == 1000
+  assert snap["feed_wait_s/sum"] == sum(float(i) for i in range(1000))
+  bins = reg._hists["feed_wait_s"][2]
+  assert len(bins) == len(metrics.HIST_BUCKETS_SECONDS) + 1
+  assert sum(bins) == 1000
+  # Values past the last bound land in the +Inf bin.
+  assert bins[-1] == 1000 - sum(
+      1 for i in range(1000) if i <= metrics.HIST_BUCKETS_SECONDS[-1])
 
 
 def test_active_registry_and_null_sink():
