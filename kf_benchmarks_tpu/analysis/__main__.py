@@ -145,6 +145,34 @@ def run_audit(args) -> int:
     for f, g, c in diffs:
       print(f"GOLDEN DIFF [{name}] {f}: golden={g!r} current={c!r}")
 
+  # Fourth audit family (ISSUE 20): the SPMD divergence analyzer
+  # (analysis/spmd.py) -- ordered-schedule drift the inventory diff
+  # cannot see, plus cross-world-size schedule agreement for every
+  # sharded golden config ({2,4,8} on the same memoized tracer; only
+  # the `bug` class fails, the gspmd twins table as `documented`).
+  spmd_total = 0
+  if not args.write_goldens:
+    from kf_benchmarks_tpu.analysis import spmd
+    drift = []
+    for name in names:
+      contract = (serving_contracts[name] if name in serving_contracts
+                  else tracer(configs[name], "train_step"))
+      for msg in spmd.schedule_drift(name, contract):
+        drift.append({"config": name, "message": msg})
+        print(f"SPMD SCHEDULE DRIFT [{name}] {msg}")
+    ws = spmd.audit_world_sizes(
+        spmd.sharded_world_size_configs(configs), tracer)
+    for name, verdict in sorted(ws["verdicts"].items()):
+      print(f"spmd world-size [{name}] sizes={verdict['sizes']}: "
+            f"{verdict['classification']}")
+    for v in ws["violations"]:
+      print(f"SPMD DIVERGENCE [{v['config']}] {v['message']}")
+    report["spmd"] = {"schedule_drift": drift, "world_size": ws}
+    spmd_total = len(drift) + len(ws["violations"])
+    print(f"spmd audit: {len(drift)} schedule drift(s), "
+          f"{len(ws['violations'])} world-size divergence(s) across "
+          f"{len(ws['verdicts'])} sharded config(s)")
+
   for name, entry in report["configs"].items():
     for v in entry["violations"]:
       print(f"CONTRACT VIOLATION [{name}] [{v['rule']}] {v['message']}")
@@ -168,7 +196,8 @@ def run_audit(args) -> int:
     # audit keeps failing until it is).
     return 1 if report["violations"] else 0
   rc_tables = run_tuned_table_audit(args)
-  return 1 if (report["violations"] or diff_total or rc_tables) else 0
+  return 1 if (report["violations"] or diff_total or spmd_total
+               or rc_tables) else 0
 
 
 def run_autotune(args) -> int:

@@ -815,6 +815,69 @@ def rule_full_mesh_replica_groups(contract, tracer):
   return []
 
 
+# -- one-owner meta-audit (ISSUE 20 satellite) --------------------------------
+
+# The "one owner per seeded violation / per program shape" comments
+# above, made checkable. Each row declares (owning rule, property,
+# binds(contract)): the rule that owns checking `property` on contracts
+# where `binds` holds. The stand-down comments in
+# rule_accum_one_collective / rule_overlap_in_backward /
+# rule_fsdp_residency / rule_serving_bounded_decode /
+# rule_state_donated are the prose versions of these predicates; this
+# table is what rule_one_owner enforces, so a future rule (or a widened
+# predicate) that silently double-claims a property fails the audit
+# with BOTH rule names instead of making the mutation self-tests
+# ambiguous about which rule must fire.
+OWNERSHIP = [
+    ("accum-one-collective", "in-scan-gradient-exchange",
+     lambda c: c.program in ("train_step", "train_chunk")
+     and not _gspmd(c) and _accum(c) > 1),
+    ("overlap-in-backward", "in-scan-gradient-exchange",
+     lambda c: c.program in ("train_step", "train_chunk")
+     and not _gspmd(c) and _accum(c) == 1 and _replicated_sync(c)
+     and not _fsdp(c)),
+    ("partitioner-twin", "in-scan-gradient-exchange",
+     lambda c: c.program in ("train_step", "train_chunk")
+     and _gspmd(c)),
+    ("fsdp-residency", "param-gather-residency",
+     lambda c: c.program == "train_step" and _fsdp(c)
+     and not _gspmd(c)),
+    ("partitioner-twin", "param-gather-residency",
+     lambda c: c.program in ("train_step", "train_chunk")
+     and _gspmd(c)),
+    ("serving-bounded-decode", "decode-buffer-bound",
+     lambda c: c.program == "serving_decode"
+     and "kv_pool_bytes" not in c.aux),
+    ("serving-paged-kv", "decode-buffer-bound",
+     lambda c: c.program == "serving_decode"
+     and "kv_pool_bytes" in c.aux),
+    ("state-donated", "state-donation",
+     lambda c: c.program not in ("serving_decode", "serving_verify")),
+    ("serving-bounded-decode", "state-donation",
+     lambda c: c.program == "serving_decode"),
+]
+
+
+def rule_one_owner(contract, tracer):
+  """ISSUE 20 satellite: no golden program shape may have TWO rules
+  claiming ownership of the same property (see OWNERSHIP). Runs as an
+  ordinary rule so every audited contract is checked; a conflict names
+  both rules and the contested property."""
+  by_property: Dict[str, set] = {}
+  for rule_id, prop, binds in OWNERSHIP:
+    if binds(contract):
+      by_property.setdefault(prop, set()).add(rule_id)
+  out = []
+  for prop, owners in sorted(by_property.items()):
+    if len(owners) > 1:
+      out.append(
+          f"property '{prop}' is claimed by {len(owners)} rules on "
+          f"this program shape: {sorted(owners)} -- exactly one rule "
+          "may own a seeded violation (the mutation self-tests assert "
+          "ONE rule fires); tighten the OWNERSHIP predicates")
+  return out
+
+
 # -- resume-time contract re-verification -------------------------------------
 
 def check_resumed_state(state, mesh, sharded_state: bool) -> List[str]:
@@ -887,6 +950,7 @@ RULES: Dict[str, Callable] = {
     "state-donated": rule_state_donated,
     "single-optimizer-apply": rule_single_optimizer_apply,
     "full-mesh-replica-groups": rule_full_mesh_replica_groups,
+    "one-owner": rule_one_owner,
 }
 
 

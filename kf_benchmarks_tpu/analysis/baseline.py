@@ -40,6 +40,12 @@ def contract_fingerprint(contract: ProgramContract) -> Dict[str, Any]:
       "collectives": _sorted_collectives(
           {"kind": k, "dtype": d, "rank": r, "placement": p, "count": n}
           for (k, d, r, p), n in inventory.items()),
+      # The ORDERED schedule (ISSUE 20): definition-order rows with
+      # group ARITY only (member ids are topology labels). Two ranks
+      # whose programs agree on the inventory above but not on this
+      # sequence can still deadlock each other -- analysis/spmd.py
+      # fails schedule drift with the exact regen command.
+      "collective_schedule": contract.collective_schedule(),
       "gradient_collectives": len(contract.gradient_collectives()),
       "in_loop_collectives": len(contract.in_loop_collectives()),
       "host_transfers": list(contract.host_transfers),
@@ -181,12 +187,25 @@ def diff_fingerprints(golden: Dict[str, Any], current: Dict[str, Any]
   """Field-level diff: [(field, golden_value, current_value), ...].
 
   Collective inventories diff per-entry so the report names the exact
-  (kind, dtype, placement) row that changed count."""
+  (kind, dtype, placement) row that changed count; the ordered
+  collective_schedule diffs at the first divergent position (plus a
+  length row) instead of dumping both full sequences."""
   diffs = []
   keys = sorted(set(golden) | set(current))
   for key in keys:
     g, c = golden.get(key), current.get(key)
-    if key == "collectives":
+    if key == "collective_schedule":
+      g_rows, c_rows = list(g or []), list(c or [])
+      if g_rows == c_rows:
+        continue
+      if len(g_rows) != len(c_rows):
+        diffs.append(("collective_schedule.length",
+                      len(g_rows), len(c_rows)))
+      for i, (gr, cr) in enumerate(zip(g_rows, c_rows)):
+        if gr != cr:
+          diffs.append((f"collective_schedule[{i}]", gr, cr))
+          break
+    elif key == "collectives":
       g_rows = {json.dumps({k: v for k, v in e.items() if k != "count"},
                            sort_keys=True): e.get("count")
                 for e in (g or [])}

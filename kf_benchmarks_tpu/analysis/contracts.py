@@ -142,10 +142,30 @@ class Collective:
   scalar: bool
   in_loop: bool        # inside a scanned (while) body
   replica_groups: str  # "" when the kind has none (collective-permute)
+  # Position in the compiled dump's definition order (the ORDERED
+  # schedule the SPMD divergence pass compares; -1 for hand-built
+  # Collectives that never went through extract_contract).
+  index: int = -1
 
   def is_gradient_traffic(self) -> bool:
     return (self.kind == "all-reduce" and not self.scalar
             and self.elems >= GRAD_MIN_ELEMS)
+
+  def schedule_entry(self) -> Dict[str, Any]:
+    """The golden-worthy row of the ordered collective schedule: every
+    field two ranks must agree on for the programs to rendezvous
+    (kind, wire dtype, scalar/tensor rank, loop placement), plus the
+    replica-group SIZES (arity) -- group member ids are topology
+    labels, not schedule structure -- and the position index."""
+    inner = self.replica_groups.strip().strip("{}")
+    sizes = ([len([t for t in grp.split(",") if t.strip() != ""])
+              for grp in inner.split("},{")] if inner else [])
+    return {
+        "index": self.index, "kind": self.kind, "dtype": self.dtype,
+        "rank": "scalar" if self.scalar else "tensor",
+        "placement": "in_loop" if self.in_loop else "top_level",
+        "group_sizes": sizes,
+    }
 
 
 @dataclasses.dataclass
@@ -169,6 +189,15 @@ class ProgramContract:
 
   def in_loop_collectives(self) -> List[Collective]:
     return [c for c in self.collectives if c.in_loop]
+
+  def collective_schedule(self) -> List[Dict[str, Any]]:
+    """The ORDERED collective schedule (ISSUE 20 leg a): one
+    :meth:`Collective.schedule_entry` row per collective, in compiled-
+    dump definition order. Two programs with identical unordered
+    inventories can still deadlock each other cross-rank when their
+    schedules differ -- the inventory is a multiset, the schedule is
+    the rendezvous order; analysis/spmd.py compares these."""
+    return [c.schedule_entry() for c in self.collectives]
 
 
 def extract_contract(hlo: str, config: Optional[dict] = None,
@@ -194,7 +223,7 @@ def extract_contract(hlo: str, config: Optional[dict] = None,
           kind=m.group("kind"), dtype=dtype, elems=elems,
           scalar=not dims, in_loop="while" in ln,
           replica_groups=groups.group(1).replace(" ", "") if groups
-          else ""))
+          else "", index=len(collectives)))
     # Only the instruction text counts (op_name metadata may quote a
     # jax scope containing e.g. 'send' without the op being one).
     head = ln.split("metadata")[0]

@@ -62,6 +62,21 @@ Rules (ids):
   reference ``file:line`` span it covers, with a reasoned allowlist
   for TPU-native-only modules (folded in from the former standalone
   citation lint; tests/test_citation_lint.py pins it).
+* ``rank-divergent-collective`` -- the host-side leg of the SPMD
+  divergence analyzer (ISSUE 20; the compiled-program legs live in
+  analysis/spmd.py): a collective/barrier call (run_barrier,
+  kfcoord_barrier, multihost_utils.*, the ops/ psum/all_gather
+  helpers) reachable under a branch on ``jax.process_index()`` /
+  ``process_count()`` / ``KFCOORD_RANK_HINT`` / ``is_chief`` is the
+  multi-host deadlock class -- one rank skips the rendezvous, every
+  other rank hangs (on our tunnel indistinguishable from the wedge).
+  Requires a nearby ``all-ranks:`` justification comment; plain
+  unguarded barrier calls need the same marker as the documented
+  barrier convention (MIGRATION.md, SURVEY 2.9 KungFu exit barrier).
+* ``rank-guarded-write`` -- a filesystem write (checkpoint /
+  run-store / golden artifacts) under a rank branch must carry the
+  ``rank0-owns:`` ownership marker: the one-writer convention has to
+  be explicit at the site, or an elastic/resharded run double-writes.
 
 Every allowlist entry is checked for staleness: an entry whose file no
 longer trips the rule must be removed, so allowlists cannot rot into
@@ -148,10 +163,9 @@ CITATION_ALLOWLIST = {
                  "handling is external runtime, SURVEY 2.9)",
     "telemetry.py": "runtime training-health layer; the reference's "
                     "observability is post-hoc only (SURVEY 5.1/9)",
-    "analysis/": "static program-contract auditor + this lint; the "
-                 "reference analog is its graph-mode structure checks "
-                 "as a TECHNIQUE (SURVEY 2), not a citable file -- see "
-                 "MIGRATION.md 'Graph-structure assumptions'",
+    # "analysis/" left the allowlist in round 22: spmd.py cites the
+    # reference KungFu exit-barrier span it guards against, so the
+    # subpackage now carries a real citation.
 }
 
 
@@ -810,6 +824,212 @@ def rule_citation(sources: List[_Source]) -> List[LintViolation]:
   return out
 
 
+# -- rules: rank-divergence (ISSUE 20 leg c) ---------------------------------
+
+# Host-level calls that issue or await a cross-rank rendezvous: every
+# rank must reach them or the job hangs. These are the HOST-side sites
+# the compiler never sees (the compiled step's schedule is checked by
+# analysis/spmd.py; this rule owns the python control flow around it).
+_BARRIER_CALL_NAMES = {"run_barrier", "kfcoord_barrier", "barrier",
+                       "sync_global_devices",
+                       "make_array_from_process_local_data"}
+_BARRIER_TEXT_MARKERS = ("multihost_utils", "distributed.initialize")
+# In-SPMD collective helpers (ops/, parallel/kungfu.py): fine unguarded
+# (the compiler schedules them identically on every rank), but reached
+# under a rank branch they are the same deadlock hazard.
+_COLLECTIVE_HELPER_NAMES = {"allreduce_mean", "broadcast", "pair_average",
+                            "sync_average", "gossip_shift", "psum",
+                            "pmean", "all_gather", "ppermute",
+                            "all_to_all"}
+# Host control flow that diverges by rank: tests mentioning any of
+# these make the branch rank-divergent.
+_RANK_TEST_MARKERS = ("process_index", "process_count",
+                      "KFCOORD_RANK_HINT", "is_chief", "current_rank")
+# Justification markers; COMMENT channel only (a docstring merely
+# mentioning the convention must not silence the rule). Concatenated so
+# this module's own constants never contain them.
+_ALL_RANKS_MARKER = "all-ranks" + ":"
+_RANK0_MARKER = "rank0-owns" + ":"
+
+RANK_DIVERGENCE_ALLOWLIST: Dict[str, str] = {}
+
+RANK_WRITE_ALLOWLIST: Dict[str, str] = {}
+
+
+def _call_names(node: ast.Call):
+  """(last, dotted) name of a call target: the final attr/id plus the
+  full dotted text (for module-path markers like multihost_utils)."""
+  func = node.func
+  last = (func.attr if isinstance(func, ast.Attribute)
+          else func.id if isinstance(func, ast.Name) else "")
+  try:
+    dotted = ast.unparse(func)
+  except Exception:
+    dotted = last
+  return last, dotted
+
+
+def _rank_guard_regions(src: _Source):
+  """[(guard_line, lo, hi)] line spans where host control flow has
+  already diverged by rank: each rank-test If's own span, plus -- for
+  the early-return shape (``if <rank-test>: return/raise`` with no
+  else, checkpoint.save_checkpoint's idiom) -- the remainder of the
+  smallest enclosing function (or module)."""
+  if src.tree is None:
+    return []
+  funcs = [n for n in ast.walk(src.tree)
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+  regions = []
+  for node in ast.walk(src.tree):
+    if not isinstance(node, ast.If):
+      continue
+    try:
+      test_text = ast.unparse(node.test)
+    except Exception:
+      continue
+    if not any(m in test_text for m in _RANK_TEST_MARKERS):
+      continue
+    end = node.end_lineno or node.lineno
+    regions.append((node.lineno, node.lineno, end))
+    terminal = bool(node.body) and isinstance(
+        node.body[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+    if terminal and not node.orelse:
+      scope_end = len(src.lines)
+      best_span = None
+      for f in funcs:
+        f_end = f.end_lineno or f.lineno
+        if f.lineno <= node.lineno <= f_end:
+          span = f_end - f.lineno
+          if best_span is None or span < best_span:
+            best_span, scope_end = span, f_end
+      regions.append((node.lineno, end + 1, scope_end))
+  return regions
+
+
+def _rank_guard_for(regions, lineno: int) -> Optional[int]:
+  """The nearest rank-test guard line whose divergent region covers
+  ``lineno``, or None when the site is reached by every rank."""
+  best = None
+  for guard, lo, hi in regions:
+    if lo <= lineno <= hi and (best is None or guard > best):
+      best = guard
+  return best
+
+
+def _marker_in_comments(src: _Source, marker: str, lo: int,
+                        hi: int) -> bool:
+  return any(marker in src.comment_lines.get(line, "")
+             for line in range(max(1, lo), hi + 1))
+
+
+def rule_rank_divergent_collective(sources: List[_Source]
+                                   ) -> List[LintViolation]:
+  out, hits = [], set()
+  for src in sources:
+    if not src.path.startswith("kf_benchmarks_tpu/") or src.tree is None:
+      continue
+    regions = _rank_guard_regions(src)
+    for node in ast.walk(src.tree):
+      if not isinstance(node, ast.Call):
+        continue
+      last, dotted = _call_names(node)
+      is_barrier = (last in _BARRIER_CALL_NAMES
+                    or any(m in dotted for m in _BARRIER_TEXT_MARKERS))
+      is_helper = last in _COLLECTIVE_HELPER_NAMES
+      if not (is_barrier or is_helper):
+        continue
+      guard = _rank_guard_for(regions, node.lineno)
+      if guard is not None:
+        lo = guard
+      elif is_barrier:
+        # The barrier convention: even an unguarded cross-rank barrier
+        # documents at the site why every rank reaches it.
+        lo = node.lineno - 4
+      else:
+        continue  # unguarded in-SPMD helper: the compiler's schedule
+      if _marker_in_comments(src, _ALL_RANKS_MARKER, lo,
+                             node.lineno + 1):
+        continue
+      hits.add(src.path)
+      if src.path in RANK_DIVERGENCE_ALLOWLIST:
+        continue
+      if guard is not None:
+        msg = (f"collective/barrier call {last or dotted}() is "
+               f"rank-divergent (rank-test guard at line {guard}) "
+               f"without an '{_ALL_RANKS_MARKER}' justification "
+               "comment -- a rank that skips the rendezvous hangs "
+               "every other rank (the multi-host deadlock class; on "
+               "our tunnel indistinguishable from the wedge hazard)")
+      else:
+        msg = (f"cross-rank barrier call {last or dotted}() without "
+               f"an '{_ALL_RANKS_MARKER}' convention comment naming "
+               "why every rank reaches it (the lint-enforced barrier "
+               "convention -- MIGRATION.md, SURVEY 2.9 KungFu exit "
+               "barrier)")
+      out.append(LintViolation("rank-divergent-collective", src.path,
+                               node.lineno, msg))
+  out += _stale_allowlist("rank-divergent-collective",
+                          RANK_DIVERGENCE_ALLOWLIST, hits,
+                          {s.path for s in sources})
+  return out
+
+
+# Filesystem mutations the one-writer convention covers. `dump`/`open`
+# appear everywhere; they only count here when RANK-GUARDED.
+_WRITE_CALL_NAMES = {"makedirs", "mkdir", "save_checkpoint",
+                     "write_golden", "write_text", "dump", "replace",
+                     "rename", "unlink", "remove", "rmtree"}
+
+
+def _is_write_open(node: ast.Call) -> bool:
+  last, _ = _call_names(node)
+  if last != "open":
+    return False
+  mode = None
+  if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+    mode = node.args[1].value
+  for kw in node.keywords:
+    if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+      mode = kw.value.value
+  return isinstance(mode, str) and any(c in mode for c in "wax")
+
+
+def rule_rank_guarded_write(sources: List[_Source]) -> List[LintViolation]:
+  out, hits = [], set()
+  for src in sources:
+    if not src.path.startswith("kf_benchmarks_tpu/") or src.tree is None:
+      continue
+    regions = _rank_guard_regions(src)
+    if not regions:
+      continue
+    for node in ast.walk(src.tree):
+      if not isinstance(node, ast.Call):
+        continue
+      last, _ = _call_names(node)
+      if not (last in _WRITE_CALL_NAMES or _is_write_open(node)):
+        continue
+      guard = _rank_guard_for(regions, node.lineno)
+      if guard is None:
+        continue
+      if _marker_in_comments(src, _RANK0_MARKER, guard,
+                             node.lineno + 1):
+        continue
+      hits.add(src.path)
+      if src.path in RANK_WRITE_ALLOWLIST:
+        continue
+      out.append(LintViolation(
+          "rank-guarded-write", src.path, node.lineno,
+          f"rank-guarded filesystem write {last or 'open'}() (rank-test "
+          f"guard at line {guard}) without a '{_RANK0_MARKER}' "
+          "ownership comment -- the rank-0-owns-it convention must be "
+          "explicit at the site (checkpoint/run-store/golden artifacts "
+          "have exactly one writer; an elastic or resharded run would "
+          "otherwise double-write)"))
+  out += _stale_allowlist("rank-guarded-write", RANK_WRITE_ALLOWLIST,
+                          hits, {s.path for s in sources})
+  return out
+
+
 # -- driver ------------------------------------------------------------------
 
 RULES = {
@@ -822,6 +1042,8 @@ RULES = {
     "metric-key-literal": rule_metric_key_literal,
     "flag-validation": rule_flag_validation,
     "citation": rule_citation,
+    "rank-divergent-collective": rule_rank_divergent_collective,
+    "rank-guarded-write": rule_rank_guarded_write,
 }
 
 

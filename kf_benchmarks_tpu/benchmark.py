@@ -1950,6 +1950,9 @@ class BenchmarkCNN:
     if p.train_dir:
       self._trace.write_ledger(p.train_dir)
     if p.sync_on_finish:
+      # all-ranks: --sync_on_finish is a launch-wide flag (same command
+      # line on every kfrun worker), so every rank takes this branch or
+      # none do -- the exit barrier always has full attendance.
       kungfu.run_barrier()
     # (ref stats dict: benchmark_cnn.py:2383-2391)
     stats = {

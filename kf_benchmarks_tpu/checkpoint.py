@@ -123,6 +123,9 @@ def save_checkpoint(train_dir: str, state, max_to_keep: int = 5,
   non-chief processes."""
   if not is_chief():
     return ""
+  # rank0-owns: the chief is the one checkpoint writer (ref
+  # --max_ckpts_to_keep semantics); non-chief ranks returned above, and
+  # restore() on every rank reads what this one rank wrote.
   os.makedirs(train_dir, exist_ok=True)
   snap = savable_state(state, sharded_opt_state=sharded_opt_state,
                        input_incarnation=input_incarnation,

@@ -38,6 +38,8 @@ def main(positional_arguments):
 
   # KungFu exit barrier (ref: tf_cnn_benchmarks.py:58-60).
   if params.variable_update == "kungfu":
+    # all-ranks: --variable_update is identical on every kfrun worker
+    # (one command line, N launches), so attendance is all-or-nothing.
     kungfu.run_barrier()
 
 

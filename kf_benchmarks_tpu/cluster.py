@@ -111,6 +111,9 @@ class JaxClusterManager(BaseClusterManager):
       # propagation, SURVEY 2.9).
       task_index = int(os.environ.get("KFCOORD_RANK_HINT",
                                       params.task_index))
+      # all-ranks: guarded on the shared worker LIST (len(workers)>1),
+      # not on this process's rank -- every worker of a multi-host
+      # launch reaches the distributed rendezvous together.
       jax.distributed.initialize(
           coordinator_address=workers[0],
           num_processes=len(workers),
@@ -122,6 +125,9 @@ class JaxClusterManager(BaseClusterManager):
     on the coordination-service exit barrier when launched under kfrun,
     else return immediately (flat SPMD has no serve-only processes)."""
     from kf_benchmarks_tpu.parallel import kungfu
+    # all-ranks: unconditional on every process that constructed a
+    # cluster manager -- run_barrier itself degrades to a no-op
+    # single-process, so attendance is exactly the world.
     kungfu.run_barrier()
 
 

@@ -187,6 +187,9 @@ class ElasticController:
     """Rendezvous before a checkpoint-restart resize: guarantees the
     chief's snapshot is on disk (the chief enters after writing) before
     any worker exits for re-exec."""
+    # all-ranks: every surviving worker of the resize enters with the
+    # same (name, count) -- the caller passes the post-resize world
+    # size, so attendance is exactly the agreed generation.
     self._client.barrier(name, count)
 
   def generation(self) -> int:

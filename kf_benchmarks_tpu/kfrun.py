@@ -68,6 +68,10 @@ def _run_generation(server, np_: int, command: List[str], logdir: str,
       env["KFCOORD_PORT"] = str(server.port)
       env["KFCOORD_WORLD"] = str(np_)
       env["KFCOORD_NAME"] = f"worker-{i}"
+      # RANK_HINT is the one env var host code may BRANCH on -- any
+      # collective/barrier under such a branch needs an all-ranks:
+      # justification (the rank-divergent-collective lint rule), and
+      # rank-guarded artifact writes a rank0-owns: marker.
       env["KFCOORD_RANK_HINT"] = str(i)
       # Per-process log capture, named the way kungfu-run names them.
       tag = f"{host}.{10000 + i}"

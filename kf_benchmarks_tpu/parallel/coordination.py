@@ -157,6 +157,8 @@ class CoordinatorClient:
   def barrier(self, name: str, count: int) -> None:
     """Block until ``count`` participants enter barrier ``name``
     (the run_barrier analog, ref: tf_cnn_benchmarks.py:58-60)."""
+    # all-ranks: the barrier PRIMITIVE itself -- attendance is the
+    # caller's contract (count is the explicit expected world).
     if self._lib.kfcoord_barrier(self._handle, name.encode(), count) != 0:
       raise RuntimeError(f"BARRIER {name} failed")
 

@@ -64,10 +64,16 @@ def run_barrier() -> None:
     with coordination.CoordinatorClient(host=host,
                                         port=int(port)) as client:
       client.join(os.environ.get("KFCOORD_NAME", f"proc-{os.getpid()}"))
+      # all-ranks: kfrun exports KFCOORD_* to every child it launches,
+      # so each of the WORLD processes takes this path and enters
+      # "kf_exit" with the same expected count.
       client.barrier("kf_exit", int(world))
     return
   if jax.process_count() > 1:
     from jax.experimental import multihost_utils
+    # all-ranks: process_count() is a global property (identical on
+    # every process of a jax.distributed job), so this branch is
+    # all-or-nothing -- full attendance at the sync.
     multihost_utils.sync_global_devices("kf_benchmarks_tpu_exit_barrier")
 
 

@@ -157,6 +157,9 @@ def put_batch(batch, sharding: NamedSharding):
   jax-native form of the reference's per-worker input splits
   (ref: preprocessing shift_ratio sharding + per-device StagingAreas)."""
   if jax.process_count() > 1:
+    # all-ranks: process_count() is identical on every process, and
+    # every process feeds a batch each step -- all ranks reach this
+    # cross-host assembly together.
     return jax.tree.map(
         lambda x: jax.make_array_from_process_local_data(
             sharding, np.asarray(x)), batch)

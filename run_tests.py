@@ -23,8 +23,11 @@ actual emitters + run-store record validity), the program-contract
 audit against tests/golden_contracts/ -- which also carries the
 tuned-table schema leg (kf_benchmarks_tpu/analysis/autotune.py
 validate_table: knob-registry membership, fingerprint re-derivation,
-stale-jax-version warnings, for the committed tuned_configs.json) --
-and the tiering audit (the static half always: the SLOW/DISTRIBUTED
+stale-jax-version warnings, for the committed tuned_configs.json) and
+the SPMD divergence legs (kf_benchmarks_tpu/analysis/spmd.py: ordered
+collective-schedule drift vs the goldens + cross-world-size agreement
+at {2,4,8}; only the `bug` class fails) -- and the tiering audit (the
+static half always: the SLOW/DISTRIBUTED
 file lists must name real files; the dynamic 60 s rule re-checks the
 durations report saved by the last --check-tiering run, which is the
 only part that needs a real suite run).
@@ -39,6 +42,11 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
+
+# Where --audit asks the analysis CLI to drop its machine-readable
+# report (ISSUE 20 satellite): the per-rule table below is built from
+# it, and CI can archive the file without rerunning the audit.
+AUDIT_REPORT_JSON = "/tmp/audit_report.json"
 
 # Durations report the --check-tiering run saves and --audit re-checks
 # (pytest does not persist durations itself).
@@ -168,6 +176,50 @@ def audit_tiering_static():
   return ok, lines
 
 
+def audit_rule_table(lint_violations=(), metrics_problems=(),
+                     report=None, tiering_lines=()):
+  """ISSUE 20 satellite: the per-rule violation table ``--audit``
+  prints (rule -> count -> first locator), so CI logs show WHICH audit
+  family failed without rerunning. Covers every family: hazard lint,
+  metrics schema, contract rules, golden diffs, the spmd divergence
+  legs, tiering. Pure (fixtures in, rows out) so tests can unit-test
+  it without running anything."""
+  rows = {}
+
+  def add(rule, locator):
+    count, first = rows.get(rule, (0, locator))
+    rows[rule] = (count + 1, first)
+
+  for v in lint_violations:
+    add(f"lint/{v.rule}", f"{v.path}:{v.line}")
+  for p in metrics_problems:
+    add("metrics-schema", str(p).splitlines()[0][:80])
+  report = report or {}
+  for name, entry in sorted((report.get("configs") or {}).items()):
+    for v in entry.get("violations", []):
+      add(f"contract/{v.get('rule', '?')}", name)
+    for d in entry.get("golden_diffs", []):
+      add("golden-diff", f"{name}:{d.get('field')}")
+  spmd = report.get("spmd") or {}
+  for d in spmd.get("schedule_drift", []):
+    add("spmd/schedule-drift", d.get("config", "?"))
+  for v in (spmd.get("world_size") or {}).get("violations", []):
+    add("spmd/world-size", v.get("config", "?"))
+  for line in tiering_lines:
+    add("tiering", str(line)[:80])
+  return [(rule, count, first)
+          for rule, (count, first) in sorted(rows.items())]
+
+
+def print_rule_table(table) -> None:
+  if not table:
+    print("audit rule table: clean (0 violations across all families)")
+    return
+  print("audit rule table (rule -> count -> first):")
+  for rule, count, first in table:
+    print(f"  {rule:<30} {count:>4}  {first}")
+
+
 def run_audit_target() -> int:
   """The --audit lint target: hazard lint + program-contract audit +
   tiering audit. CPU-only, no device execution, <60 s."""
@@ -200,18 +252,31 @@ def run_audit_target() -> int:
     print(p)
   print(f"metrics-schema audit: {len(problems)} problem(s)")
   failed |= bool(problems)
-  # 2. Program contracts vs goldens: needs the 8-device virtual CPU
-  # mesh, so it runs in the analysis CLI's own interpreter (which sets
-  # XLA_FLAGS before the backend initializes).
+  # 2. Program contracts vs goldens (+ the spmd schedule/world-size
+  # legs): needs the 8-device virtual CPU mesh, so it runs in the
+  # analysis CLI's own interpreter (which sets XLA_FLAGS before the
+  # backend initializes). --json drops the machine-readable report the
+  # per-rule table below is built from.
   rc = subprocess.call(
-      [sys.executable, "-m", "kf_benchmarks_tpu.analysis", "audit"],
-      cwd=REPO)
+      [sys.executable, "-m", "kf_benchmarks_tpu.analysis", "audit",
+       "--json", AUDIT_REPORT_JSON], cwd=REPO)
   failed |= bool(rc)
+  report = None
+  try:
+    with open(AUDIT_REPORT_JSON, encoding="utf-8") as f:
+      report = json.load(f)
+  except (OSError, ValueError):
+    print(f"audit: no report at {AUDIT_REPORT_JSON} (analysis CLI "
+          "failed before writing it?)")
   # 3. Tiering audit (static + saved-report re-check).
   ok, lines = audit_tiering_static()
   for line in lines:
     print(line)
   failed |= not ok
+  # 4. The per-rule violation table (ISSUE 20 satellite): which family
+  # failed, how often, and where first -- without rerunning.
+  print_rule_table(audit_rule_table(
+      violations, problems, report, () if ok else lines))
   print("audit target: " + ("FAIL" if failed else "OK"))
   return 1 if failed else 0
 

@@ -1,0 +1,110 @@
+"""--audit per-rule violation table (ISSUE 20 satellite 2;
+run_tests.py audit_rule_table/print_rule_table).
+
+Pure-unit: the builder is fixtures-in/rows-out, so these tests cover
+every audit family's row shape -- hazard lint, metrics schema,
+contract rules, golden diffs, both spmd legs, tiering -- without
+running the audit. The end-to-end path (analysis CLI --json -> table)
+rides the real ``run_tests.py --audit`` target.
+"""
+
+import importlib.util
+import os
+import types
+
+MODULE_PATH = os.path.join(os.path.dirname(__file__), "..", "run_tests.py")
+
+
+def _load():
+  spec = importlib.util.spec_from_file_location("run_tests_table",
+                                                MODULE_PATH)
+  mod = importlib.util.module_from_spec(spec)
+  spec.loader.exec_module(mod)
+  return mod
+
+
+run_tests = _load()
+
+
+def _lint(rule, path, line):
+  return types.SimpleNamespace(rule=rule, path=path, line=line)
+
+
+_REPORT = {
+    "configs": {
+        "sharded_base": {
+            "violations": [{"rule": "wire-dtype", "message": "m"},
+                           {"rule": "wire-dtype", "message": "m2"}],
+            "golden_diffs": [{"field": "collective_schedule[0]",
+                              "golden": 1, "current": 2}],
+        },
+        "base": {"violations": [], "golden_diffs": []},
+    },
+    "spmd": {
+        "schedule_drift": [{"config": "fsdp_base", "message": "drift"}],
+        "world_size": {
+            "verdicts": {},
+            "violations": [{"config": "lm_sharded", "message": "b1"},
+                           {"config": "sharded_base", "message": "b2"}],
+        },
+    },
+}
+
+
+def test_table_covers_every_family_with_counts_and_first_locator():
+  table = run_tests.audit_rule_table(
+      lint_violations=[
+          _lint("rank-divergent-collective", "kf_benchmarks_tpu/a.py", 7),
+          _lint("rank-divergent-collective", "kf_benchmarks_tpu/b.py", 9),
+          _lint("citation", "kf_benchmarks_tpu/c.py", 1),
+      ],
+      metrics_problems=["schema key missing: foo/bar"],
+      report=_REPORT,
+      tiering_lines=["tests/test_slow.py::test_x took 61.0s"])
+  rows = {rule: (count, first) for rule, count, first in table}
+  assert rows["lint/rank-divergent-collective"] == (
+      2, "kf_benchmarks_tpu/a.py:7")  # first occurrence wins
+  assert rows["lint/citation"] == (1, "kf_benchmarks_tpu/c.py:1")
+  assert rows["metrics-schema"] == (1, "schema key missing: foo/bar")
+  assert rows["contract/wire-dtype"] == (2, "sharded_base")
+  assert rows["golden-diff"] == (1, "sharded_base:collective_schedule[0]")
+  assert rows["spmd/schedule-drift"] == (1, "fsdp_base")
+  assert rows["spmd/world-size"] == (2, "lm_sharded")
+  assert rows["tiering"][0] == 1
+  # Deterministic ordering for CI log diffing.
+  assert [r for r, _, _ in table] == sorted(r for r, _, _ in table)
+
+
+def test_table_empty_inputs_yield_no_rows():
+  assert run_tests.audit_rule_table() == []
+  assert run_tests.audit_rule_table(report={"configs": {}, "spmd": {
+      "schedule_drift": [], "world_size": {"violations": []}}}) == []
+
+
+def test_print_rule_table_clean_line(capsys):
+  run_tests.print_rule_table([])
+  out = capsys.readouterr().out
+  assert "audit rule table: clean (0 violations across all families)" in out
+
+
+def test_print_rule_table_rows(capsys):
+  run_tests.print_rule_table([("lint/citation", 3,
+                               "kf_benchmarks_tpu/c.py:1")])
+  out = capsys.readouterr().out
+  assert "rule -> count -> first" in out
+  assert "lint/citation" in out and "kf_benchmarks_tpu/c.py:1" in out
+
+
+def test_audit_target_forwards_the_json_report_path():
+  """The subprocess leg must ask the analysis CLI for the JSON report
+  the table is built from (satellite: --audit forwards --json)."""
+  assert run_tests.AUDIT_REPORT_JSON
+  import ast
+  tree = ast.parse(open(MODULE_PATH).read())
+  target = [n for n in ast.walk(tree)
+            if isinstance(n, ast.FunctionDef) and
+            n.name == "run_audit_target"]
+  assert target
+  src = ast.unparse(target[0])
+  assert "--json" in src and "AUDIT_REPORT_JSON" in src
+  assert "audit_rule_table" in src and "print_rule_table" in src

@@ -1,0 +1,225 @@
+"""Rank-divergence lint (ISSUE 20 leg c; analysis/lint.py rules
+``rank-divergent-collective`` and ``rank-guarded-write``).
+
+Layers (mirrors tests/test_hazard_lint.py):
+  * seeded violations in throwaway repo layouts: an unannotated
+    ``process_index()``-guarded barrier (the acceptance fixture), a
+    rank-guarded collective helper, an unguarded barrier missing the
+    convention comment, and a rank-guarded artifact write -- each
+    caught by the intended rule, and each annotated twin stays clean.
+  * allowlist plumbing + staleness (satellite 4): an allowlisted path
+    is silent, a gone-file entry and a no-longer-tripping entry are
+    themselves violations.
+  * acceptance: both rules are clean on the real tree (the annotated
+    cluster.py / kfrun.py / checkpoint.py sites pass as annotated).
+"""
+
+import os
+
+from kf_benchmarks_tpu.analysis import lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Markers built the way lint.py builds them, so grepping this test for
+# the literal never confuses the comment-channel convention.
+ALL_RANKS = "all-ranks" + ":"
+RANK0 = "rank0-owns" + ":"
+
+
+def _seed(tmp_path, rel, text):
+  path = tmp_path / rel
+  path.parent.mkdir(parents=True, exist_ok=True)
+  path.write_text(text)
+  return path
+
+
+def _rules(tmp_path, rule):
+  return lint.run_lint(str(tmp_path), rules=[rule])
+
+
+# -- rank-divergent-collective: guarded barrier (THE acceptance seed) ---------
+
+GUARDED_BARRIER = (
+    "import jax\n"
+    "from kf_benchmarks_tpu.parallel import kungfu\n"
+    "\n"
+    "def finish():\n"
+    "  if jax.process_index() == 0:\n"
+    "    kungfu.run_barrier()\n")
+
+
+def test_guarded_barrier_without_justification_fires(tmp_path):
+  _seed(tmp_path, "kf_benchmarks_tpu/foo.py", GUARDED_BARRIER)
+  v = _rules(tmp_path, "rank-divergent-collective")
+  assert [(x.path, x.line) for x in v] == [("kf_benchmarks_tpu/foo.py", 6)]
+  assert "rank-test guard at line 5" in v[0].message
+  assert ALL_RANKS in v[0].message
+  assert lint.main(["--root", str(tmp_path),
+                    "--rules", "rank-divergent-collective"]) == 1
+
+
+def test_guarded_barrier_with_justification_is_clean(tmp_path):
+  annotated = GUARDED_BARRIER.replace(
+      "    kungfu.run_barrier()",
+      f"    # {ALL_RANKS} rank 0 re-enters for the late joiner; every\n"
+      "    # other rank is parked in the same barrier by join_server\n"
+      "    kungfu.run_barrier()")
+  _seed(tmp_path, "kf_benchmarks_tpu/foo.py", annotated)
+  assert not _rules(tmp_path, "rank-divergent-collective")
+
+
+def test_justification_in_docstring_does_not_silence(tmp_path):
+  """The marker is a COMMENT-channel convention: a docstring merely
+  mentioning it must not pass the site."""
+  doc = GUARDED_BARRIER.replace(
+      "def finish():\n",
+      f'def finish():\n  """{ALL_RANKS} mentioned in prose only."""\n')
+  _seed(tmp_path, "kf_benchmarks_tpu/foo.py", doc)
+  assert len(_rules(tmp_path, "rank-divergent-collective")) == 1
+
+
+def test_allowlisted_guarded_barrier_is_silent(tmp_path, monkeypatch):
+  monkeypatch.setattr(lint, "RANK_DIVERGENCE_ALLOWLIST",
+                      {"kf_benchmarks_tpu/foo.py": "transition period"})
+  _seed(tmp_path, "kf_benchmarks_tpu/foo.py", GUARDED_BARRIER)
+  assert not _rules(tmp_path, "rank-divergent-collective")
+
+
+# -- rank-divergent-collective: guarded in-SPMD helper ------------------------
+
+def test_guarded_collective_helper_fires_unguarded_is_fine(tmp_path):
+  _seed(tmp_path, "kf_benchmarks_tpu/bar.py",
+        "from kf_benchmarks_tpu import ops\n"
+        "import jax\n"
+        "\n"
+        "def f(x):\n"
+        "  if jax.process_index() == 0:\n"
+        "    return ops.allreduce_mean(x)\n"
+        "  return x\n"
+        "\n"
+        "def g(x):\n"
+        "  return ops.allreduce_mean(x)\n")
+  v = _rules(tmp_path, "rank-divergent-collective")
+  # Only the guarded call: unguarded in-SPMD helpers are scheduled
+  # identically on every rank by the compiler (analysis/spmd.py owns
+  # that leg), so line 10 stays clean.
+  assert [x.line for x in v] == [6]
+  assert "allreduce_mean" in v[0].message
+
+
+# -- rank-divergent-collective: the unguarded-barrier convention --------------
+
+UNGUARDED_BARRIER = (
+    "from jax.experimental import multihost_utils\n"
+    "\n"
+    "def sync():\n"
+    "  multihost_utils.sync_global_devices('epoch')\n")
+
+
+def test_unguarded_barrier_needs_convention_comment(tmp_path):
+  _seed(tmp_path, "kf_benchmarks_tpu/baz.py", UNGUARDED_BARRIER)
+  v = _rules(tmp_path, "rank-divergent-collective")
+  assert len(v) == 1 and v[0].line == 4
+  assert "convention comment" in v[0].message
+
+
+def test_unguarded_barrier_with_convention_comment_is_clean(tmp_path):
+  annotated = UNGUARDED_BARRIER.replace(
+      "def sync():\n",
+      f"# {ALL_RANKS} every process calls sync() once per epoch from\n"
+      "# the training loop; no rank branch reaches here\n"
+      "def sync():\n")
+  _seed(tmp_path, "kf_benchmarks_tpu/baz.py", annotated)
+  assert not _rules(tmp_path, "rank-divergent-collective")
+
+
+# -- rank-guarded-write -------------------------------------------------------
+
+GUARDED_WRITE = (
+    "import os\n"
+    "import jax\n"
+    "\n"
+    "def save(path, blob):\n"
+    "  if jax.process_index() != 0:\n"
+    "    return ''\n"
+    "  os.makedirs(path, exist_ok=True)\n"
+    "  with open(os.path.join(path, 'blob'), 'w') as f:\n"
+    "    f.write(blob)\n"
+    "  return path\n")
+
+
+def test_early_return_guarded_write_fires(tmp_path):
+  """checkpoint.save_checkpoint's idiom: everything after the
+  ``if not chief: return`` is rank-divergent."""
+  _seed(tmp_path, "kf_benchmarks_tpu/ckpt.py", GUARDED_WRITE)
+  v = _rules(tmp_path, "rank-guarded-write")
+  assert [x.line for x in v] == [7, 8]  # makedirs + write-mode open
+  assert all("rank-test guard at line 5" in x.message for x in v)
+  assert RANK0 in v[0].message
+
+
+def test_ownership_comment_after_the_guard_silences_the_region(tmp_path):
+  annotated = GUARDED_WRITE.replace(
+      "  os.makedirs(path, exist_ok=True)\n",
+      f"  # {RANK0} the chief is the one artifact writer; every other\n"
+      "  # rank returned above\n"
+      "  os.makedirs(path, exist_ok=True)\n")
+  _seed(tmp_path, "kf_benchmarks_tpu/ckpt.py", annotated)
+  assert not _rules(tmp_path, "rank-guarded-write")
+
+
+def test_unguarded_write_is_not_this_rules_business(tmp_path):
+  _seed(tmp_path, "kf_benchmarks_tpu/plain.py",
+        "import os\n"
+        "import jax\n"
+        "\n"
+        "def log_rank():\n"
+        "  if jax.process_index() == 0:\n"
+        "    pass\n"
+        "\n"
+        "def mkdirs(path):\n"
+        "  os.makedirs(path, exist_ok=True)\n")
+  assert not _rules(tmp_path, "rank-guarded-write")
+
+
+# -- allowlist staleness (satellite 4) ----------------------------------------
+
+def test_stale_allowlist_file_gone(tmp_path, monkeypatch):
+  monkeypatch.setattr(lint, "RANK_DIVERGENCE_ALLOWLIST",
+                      {"kf_benchmarks_tpu/gone.py": "was migrating"})
+  _seed(tmp_path, "kf_benchmarks_tpu/foo.py", GUARDED_BARRIER)
+  v = _rules(tmp_path, "rank-divergent-collective")
+  stale = [x for x in v if x.path == "kf_benchmarks_tpu/gone.py"]
+  assert len(stale) == 1 and "file gone" in stale[0].message
+
+
+def test_stale_allowlist_no_longer_trips(tmp_path, monkeypatch):
+  monkeypatch.setattr(lint, "RANK_WRITE_ALLOWLIST",
+                      {"kf_benchmarks_tpu/ckpt.py": "pending annotation"})
+  _seed(tmp_path, "kf_benchmarks_tpu/ckpt.py",
+        "def save():\n  return ''\n")
+  v = _rules(tmp_path, "rank-guarded-write")
+  assert len(v) == 1
+  assert "no longer trips" in v[0].message and "remove" in v[0].message
+
+
+# -- acceptance: the real tree passes as annotated ----------------------------
+
+def test_rank_rules_clean_at_head():
+  v = lint.run_lint(REPO, rules=["rank-divergent-collective",
+                                 "rank-guarded-write"])
+  assert not v, "\n".join(x.render() for x in v)
+
+
+def test_head_sites_are_annotated_not_unreached():
+  """The clean pass above must come from the justification comments,
+  not from the rules failing to see the sites: the known rank-guarded
+  sites carry the markers."""
+  def comments_of(rel):
+    src = [s for s in lint.iter_sources(REPO) if s.path == rel]
+    assert src, rel
+    return "\n".join(src[0].comment_lines.values())
+
+  assert ALL_RANKS in comments_of("kf_benchmarks_tpu/cluster.py")
+  assert ALL_RANKS in comments_of("kf_benchmarks_tpu/benchmark.py")
+  assert RANK0 in comments_of("kf_benchmarks_tpu/checkpoint.py")
